@@ -1,0 +1,31 @@
+"""distributed_sudoku_solver_tpu — TPU-native constraint-satisfaction framework.
+
+A brand-new JAX / XLA / Pallas / pjit framework with the capabilities of the
+reference P2P distributed Sudoku solver (see SURVEY.md): batched bitmask
+constraint propagation + speculative-parallel search on TPU, sharded over a
+device mesh, fronted by the reference-compatible HTTP API.
+
+Layer map (TPU-native re-design of SURVEY.md §1; layers land bottom-up —
+anything not present in the tree yet is marked [planned]):
+
+  L0  compute kernel   ops/            jit-compiled bitmask propagation + frontier step
+  L2  scheduler        ops/solve.py    frontier tensor IS the work pool; branching,
+                                       stealing and cancellation are in-graph
+  L2' multi-chip       parallel/       shard_map over a Mesh; steal/solved
+                                       broadcast as ICI collectives
+  L3  membership/FT    runtime/cluster.py   typed TCP control plane (join, heartbeat,
+                                       failure detection, re-dispatch)
+  L4  client API       runtime/server.py    POST /solve, GET /stats, GET /network
+  L5  CLI/config       cli.py, models/geometry.py
+"""
+
+__version__ = "0.1.0"
+
+from distributed_sudoku_solver_tpu.models.geometry import (  # noqa: F401
+    Geometry,
+    SUDOKU_4,
+    SUDOKU_9,
+    SUDOKU_16,
+    SUDOKU_25,
+    geometry_for_size,
+)

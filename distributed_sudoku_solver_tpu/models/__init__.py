@@ -1,0 +1,8 @@
+from distributed_sudoku_solver_tpu.models.geometry import (  # noqa: F401
+    Geometry,
+    SUDOKU_4,
+    SUDOKU_9,
+    SUDOKU_16,
+    SUDOKU_25,
+    geometry_for_size,
+)

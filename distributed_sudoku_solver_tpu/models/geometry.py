@@ -1,0 +1,83 @@
+"""Board geometry — the config object that kills the reference's hard-coding.
+
+The reference hard-codes board size 9 and box size 3 inside its kernel
+(``/root/reference/utils.py:20-21,48-53``) and its checker
+(``/root/reference/sudoku.py:22-31,48-68``), which is why its 16x16/25x25
+configs cannot run (SURVEY.md §2.5 #9).  Here geometry is a frozen dataclass
+threaded through every kernel, so one compiled code path serves 4x4 test
+boards, 9x9, 16x16 hexadoku and 25x25 giant boards (BASELINE.json configs).
+
+Candidate masks are uint32 bitmasks: bit d set  <=>  digit d+1 still possible.
+25x25 needs 25 bits, so uint32 covers every supported geometry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """Sudoku-family board geometry: an n x n grid of (box_h x box_w) boxes."""
+
+    box_h: int
+    box_w: int
+
+    def __post_init__(self) -> None:
+        if self.box_h < 1 or self.box_w < 1:
+            raise ValueError(f"box dims must be >= 1, got {self.box_h}x{self.box_w}")
+        if self.n > 32:
+            raise ValueError(f"n={self.n} exceeds uint32 mask capacity (32 digits)")
+
+    @property
+    def n(self) -> int:
+        """Digits per unit == rows == cols (n = box_h * box_w)."""
+        return self.box_h * self.box_w
+
+    @property
+    def n_cells(self) -> int:
+        return self.n * self.n
+
+    @property
+    def full_mask(self) -> int:
+        """Bitmask with all n digit bits set (the 'anything possible' cell)."""
+        return (1 << self.n) - 1
+
+    @property
+    def mask_dtype(self):
+        return jnp.uint32
+
+    @property
+    def n_vboxes(self) -> int:
+        """Boxes stacked vertically: n / box_h."""
+        return self.n // self.box_h
+
+    @property
+    def n_hboxes(self) -> int:
+        """Boxes side by side: n / box_w."""
+        return self.n // self.box_w
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return f"{self.n}x{self.n}({self.box_h}x{self.box_w})"
+
+
+SUDOKU_4 = Geometry(2, 2)
+SUDOKU_6 = Geometry(2, 3)
+SUDOKU_9 = Geometry(3, 3)
+SUDOKU_16 = Geometry(4, 4)
+SUDOKU_25 = Geometry(5, 5)
+
+_BY_SIZE = {g.n: g for g in (SUDOKU_4, SUDOKU_6, SUDOKU_9, SUDOKU_16, SUDOKU_25)}
+
+
+def geometry_for_size(n: int) -> Geometry:
+    """Geometry for a square-box (or known) board size n."""
+    try:
+        return _BY_SIZE[n]
+    except KeyError:
+        root = int(round(n**0.5))
+        if root * root == n:
+            return Geometry(root, root)
+        raise ValueError(f"no known geometry for board size {n}") from None

@@ -1,0 +1,11 @@
+from distributed_sudoku_solver_tpu.ops.bitmask import (  # noqa: F401
+    encode_grid,
+    decode_grid,
+    popcount,
+    lowest_bit,
+)
+from distributed_sudoku_solver_tpu.ops.propagate import (  # noqa: F401
+    propagate,
+    propagate_sweep,
+    board_status,
+)

@@ -1,0 +1,125 @@
+"""Bitmask primitives for the candidate-tensor board encoding.
+
+TPU-native replacement for the reference's list-of-lists grid + per-guess
+membership scans (``/root/reference/utils.py:27-55`` ``is_valid`` walks the
+row, column and box in Python per call).  Here a board is a ``uint32[n, n]``
+tensor of candidate bitmasks — bit ``d`` set means digit ``d+1`` is still
+possible — and every constraint check in the framework is a vectorized
+boolean/integer op on that tensor, batched over an arbitrary leading shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+
+
+def popcount(x: jax.Array) -> jax.Array:
+    """Number of set bits per element (candidate count of a cell)."""
+    return jax.lax.population_count(x)
+
+
+def lowest_bit(x: jax.Array) -> jax.Array:
+    """Isolate the lowest set bit: the *ascending-digit* branch choice.
+
+    Matches the reference's guess order (``for number in arr`` ascending,
+    ``/root/reference/DHT_Node.py:522``) so branch-and-bound explores digits
+    low-to-high and unique-solution puzzles decode bit-exactly.
+    """
+    return x & (~x + jnp.uint32(1))
+
+
+def is_single(x: jax.Array) -> jax.Array:
+    """True where the cell is decided (exactly one candidate)."""
+    return popcount(x) == 1
+
+
+def mask_to_value(x: jax.Array) -> jax.Array:
+    """Singleton mask -> digit value in 1..n; non-singletons -> 0.
+
+    Uses count-leading-zeros so it needs no lookup table at any geometry.
+    """
+    x = x.astype(jnp.uint32)
+    bit_index = 31 - jax.lax.clz(x).astype(jnp.int32)
+    return jnp.where(is_single(x), bit_index + 1, 0).astype(jnp.int32)
+
+
+def value_to_mask(v: jax.Array, geom: Geometry) -> jax.Array:
+    """Digit value (1..n; 0 = empty) -> candidate mask (empty -> full mask).
+
+    Out-of-range values (negative or > n) map to the empty mask 0, which is a
+    contradiction: corrupt input yields a clean "unsat" verdict instead of
+    being silently clipped into a legal-looking clue.
+    """
+    v = v.astype(jnp.int32)
+    given = jnp.uint32(1) << jnp.clip(v - 1, 0, geom.n - 1).astype(jnp.uint32)
+    out = jnp.where(v > 0, given, jnp.uint32(geom.full_mask))
+    in_range = (v >= 0) & (v <= geom.n)
+    return jnp.where(in_range, out, jnp.uint32(0))
+
+
+def encode_grid(grid: jax.Array, geom: Geometry) -> jax.Array:
+    """int grid [..., n, n] (0 = empty) -> candidate tensor uint32 [..., n, n]."""
+    return value_to_mask(jnp.asarray(grid), geom)
+
+
+def decode_grid(cand: jax.Array) -> jax.Array:
+    """Candidate tensor -> int32 grid; undecided/contradicted cells -> 0."""
+    return mask_to_value(cand)
+
+
+def or_reduce(x: jax.Array, axis: int) -> jax.Array:
+    """Bitwise-OR reduction along one axis (the 'digits seen in this unit' op)."""
+    return jax.lax.reduce(
+        x, jnp.uint32(0), lambda a, b: jax.lax.bitwise_or(a, b), (axis % x.ndim,)
+    )
+
+
+def once_twice_reduce(x: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
+    """Along ``axis``: bits set in >=1 element (``once``) and >=2 (``twice``).
+
+    ``once & ~twice`` is the hidden-singles mask: digits with exactly one home
+    in the unit.  The combine ((o1,t1),(o2,t2)) -> (o1|o2, t1|t2|(o1&o2)) is
+    associative, so a log-depth tree reduction keeps the XLA graph small even
+    for 25-wide units.
+    """
+    axis = axis % x.ndim
+    x = jnp.moveaxis(x, axis, -1)
+    once, twice = x, jnp.zeros_like(x)
+    n = x.shape[-1]
+    # Pad to a power of two with identity (0, 0) elements.
+    pow2 = 1 << (n - 1).bit_length()
+    if pow2 != n:
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, pow2 - n)]
+        once = jnp.pad(once, pad)
+        twice = jnp.pad(twice, pad)
+    while once.shape[-1] > 1:
+        h = once.shape[-1] // 2
+        o1, o2 = once[..., :h], once[..., h:]
+        t1, t2 = twice[..., :h], twice[..., h:]
+        once, twice = o1 | o2, t1 | t2 | (o1 & o2)
+    return once[..., 0], twice[..., 0]
+
+
+def to_boxes(cand: jax.Array, geom: Geometry) -> jax.Array:
+    """[..., n, n] -> [..., n_boxes, cells_per_box] view of the box units.
+
+    Rows split as (n_vboxes, box_h), cols as (n_hboxes, box_w); transposing the
+    middle axes groups each box's cells contiguously.  Cell order inside a box
+    is row-major, matching the reference checker's box walk
+    (``/root/reference/sudoku.py:48-68``).
+    """
+    lead = cand.shape[:-2]
+    x = cand.reshape(*lead, geom.n_vboxes, geom.box_h, geom.n_hboxes, geom.box_w)
+    x = jnp.swapaxes(x, -3, -2)
+    return x.reshape(*lead, geom.n, geom.n)
+
+
+def from_boxes(boxes: jax.Array, geom: Geometry) -> jax.Array:
+    """Inverse of :func:`to_boxes`."""
+    lead = boxes.shape[:-2]
+    x = boxes.reshape(*lead, geom.n_vboxes, geom.n_hboxes, geom.box_h, geom.box_w)
+    x = jnp.swapaxes(x, -3, -2)
+    return x.reshape(*lead, geom.n, geom.n)

@@ -1,0 +1,270 @@
+"""The lane-stack frontier: the reference's worker ring as one device tensor.
+
+TPU-native re-design of the reference's entire L2 scheduler (SURVEY.md §1,
+§2.1 #6/#7).  The mapping is one-to-one:
+
+* **lane = worker node.**  Each of L lanes owns a private DFS stack
+  ``stack[L, S, n, n]`` of partial boards (candidate bitmasks) with stack
+  pointer ``sp[L]`` — the reference's per-node recursion stack and
+  ``task_queue`` unified into one tensor.
+* **branch = the reference's guess loop.**  Each step, every live lane pops
+  its top board, propagates it to a fixpoint, and (if undecided) splits one
+  cell binarily: the *lowest candidate digit* (pushed on top, explored next —
+  exact ascending-digit DFS order, ``/root/reference/DHT_Node.py:522``)
+  vs. *the rest* (left underneath).  All lanes branch in lockstep: one
+  ``lax.while_loop`` iteration advances every lane.
+* **work stealing = the NEEDWORK handshake, tensorized.**  Idle lanes
+  (empty stack, or their job already solved) are matched each step with the
+  richest lanes, and steal the *bottom* stack entry — the shallowest node,
+  i.e. the largest unexplored subtree, the moral equivalent of the
+  reference's ``split_array_in_middle`` shipping half the guess range
+  (``/root/reference/DHT_Node.py:499-510``, ``utils.py:1-9``).  No
+  messages, no idle chip while any lane has depth >= 2.
+* **speculative cancellation = the SOLUTION_FOUND purge, in-graph.**  Lanes
+  whose job is solved are cleared by a mask (``/root/reference/
+  DHT_Node.py:358-387``) and immediately become thieves for other jobs.
+
+Per-lane LIFO makes progress unconditional (pop 1, push <= 2 per step), so
+unlike a flat expansion pool the frontier cannot deadlock at capacity; a
+stack that would overflow S drops its *rest* sibling and records the loss
+per job (``overflowed``), downgrading a would-be "unsat" verdict to
+"unknown" rather than ever reporting wrongly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import lowest_bit, popcount
+from distributed_sudoku_solver_tpu.ops.propagate import board_status, propagate
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Static solver configuration (hashable: becomes part of the jit key)."""
+
+    lanes: int = 0  # total lanes; 0 = auto: max(n_jobs, min_lanes)
+    min_lanes: int = 64  # speculation width floor for small job counts
+    stack_slots: int = 64  # DFS stack depth per lane
+    max_steps: int = 100_000  # branch rounds before giving up
+    max_sweeps: int = 64  # propagation sweeps per fixpoint
+    branch: str = "minrem"  # 'minrem' (fastest) | 'first' (reference order)
+    steal: bool = True  # receiver-initiated work stealing between lanes
+
+    def resolve_lanes(self, n_jobs: int) -> int:
+        lanes = self.lanes if self.lanes > 0 else max(n_jobs, self.min_lanes)
+        if lanes < n_jobs:
+            raise ValueError(f"lanes={lanes} < n_jobs={n_jobs}")
+        return lanes
+
+
+class Frontier(NamedTuple):
+    """Loop-carried device state for one solve call."""
+
+    stack: jax.Array  # uint32[L, S, n, n] candidate masks
+    sp: jax.Array  # int32[L] stack pointer (0 = empty lane)
+    job: jax.Array  # int32[L] owning job; -1 = unassigned
+    solved: jax.Array  # bool[J]
+    solution: jax.Array  # uint32[J, n, n] (candidate form; all singles)
+    overflowed: jax.Array  # bool[J] some subtree was dropped (stack full)
+    nodes: jax.Array  # int32[J] branch nodes expanded per job
+    steps: jax.Array  # int32 scalar
+    sweeps: jax.Array  # int32 scalar total propagation sweeps
+    expansions: jax.Array  # int32 scalar total branch expansions
+    steals: jax.Array  # int32 scalar total bottom-steals
+
+
+def init_frontier(cand0: jax.Array, config: SolverConfig) -> Frontier:
+    """Seed lane j with job j's root board (the root TASK self-send,
+    ``/root/reference/DHT_Node.py:551``); extra lanes start as thieves."""
+    n_jobs, n, _ = cand0.shape
+    n_lanes = config.resolve_lanes(n_jobs)
+    s = config.stack_slots
+    stack = jnp.zeros((n_lanes, s, n, n), jnp.uint32)
+    stack = stack.at[:n_jobs, 0].set(cand0.astype(jnp.uint32))
+    sp = jnp.where(jnp.arange(n_lanes) < n_jobs, 1, 0).astype(jnp.int32)
+    job = jnp.where(
+        jnp.arange(n_lanes) < n_jobs, jnp.arange(n_lanes), -1
+    ).astype(jnp.int32)
+    return Frontier(
+        stack=stack,
+        sp=sp,
+        job=job,
+        solved=jnp.zeros(n_jobs, bool),
+        solution=jnp.zeros((n_jobs, n, n), jnp.uint32),
+        overflowed=jnp.zeros(n_jobs, bool),
+        nodes=jnp.zeros(n_jobs, jnp.int32),
+        steps=jnp.int32(0),
+        sweeps=jnp.int32(0),
+        expansions=jnp.int32(0),
+        steals=jnp.int32(0),
+    )
+
+
+def _branch_cell_onehot(cand: jax.Array, branch: str) -> jax.Array:
+    """bool[L, n, n] one-hot of the cell to branch on per board.
+
+    'minrem': fewest remaining candidates (ties -> first row-major) — MRV.
+    'first': first undecided cell row-major — the reference's
+    ``find_next_empty`` order (``/root/reference/utils.py:14-25``).
+    """
+    lanes, n, _ = cand.shape
+    pc = popcount(cand).reshape(lanes, n * n).astype(jnp.int32)
+    cell_idx = jnp.arange(n * n, dtype=jnp.int32)
+    if branch == "minrem":
+        key = jnp.where(pc > 1, pc * (n * n) + cell_idx, jnp.int32(2**30))
+    elif branch == "first":
+        key = jnp.where(pc > 1, cell_idx, jnp.int32(2**30))
+    else:  # pragma: no cover - config validation
+        raise ValueError(f"unknown branch mode {branch!r}")
+    chosen = jnp.argmin(key, axis=-1)
+    onehot = cell_idx[None, :] == chosen[:, None]
+    return onehot.reshape(lanes, n, n)
+
+
+def _steal(
+    stack: jax.Array, sp: jax.Array, job: jax.Array, job_live: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Match idle lanes with the richest lanes; move each donor's *bottom* row.
+
+    Receiver-initiated like the reference's NEEDWORK (``/root/reference/
+    DHT_Node.py:246-254``); donors are served richest-first so the deepest
+    backlogs drain first, and each donor serves at most one thief per step.
+    """
+    n_lanes = sp.shape[0]
+    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
+
+    idle = sp == 0
+    donor = (sp >= 2) & job_live
+    # Thieves in lane order; donors richest-first.  argsort is a permutation,
+    # so donors are distinct; pair k-th thief with k-th donor.
+    thief_order = jnp.argsort(jnp.where(idle, lane_idx, n_lanes + lane_idx))
+    donor_order = jnp.argsort(jnp.where(donor, -sp, jnp.int32(1)), stable=True)
+    n_pairs = jnp.minimum(jnp.sum(idle), jnp.sum(donor)).astype(jnp.int32)
+    pair = lane_idx < n_pairs
+
+    thief_lane = jnp.where(pair, thief_order, n_lanes)  # OOB -> dropped
+    donor_lane = jnp.where(pair, donor_order, n_lanes)
+
+    stolen = stack[jnp.clip(donor_lane, 0, n_lanes - 1), 0]
+    stolen_job = job[jnp.clip(donor_lane, 0, n_lanes - 1)]
+
+    # Thieves: bottom row becomes their whole stack.
+    stack = stack.at[thief_lane, 0].set(stolen, mode="drop")
+    sp = sp.at[thief_lane].set(jnp.where(pair, 1, 0), mode="drop")
+    job = job.at[thief_lane].set(stolen_job, mode="drop")
+
+    # Donors: shift their stack down one slot.
+    donor_sel = jnp.zeros(n_lanes, bool).at[donor_lane].set(pair, mode="drop")
+    shifted = jnp.concatenate([stack[:, 1:], stack[:, -1:]], axis=1)
+    stack = jnp.where(donor_sel[:, None, None, None], shifted, stack)
+    sp = jnp.where(donor_sel, sp - 1, sp)
+    return stack, sp, job, n_pairs
+
+
+def frontier_step(state: Frontier, geom: Geometry, config: SolverConfig) -> Frontier:
+    """One lockstep round: pop+propagate tops -> harvest/cancel -> branch -> steal."""
+    n_lanes, s, n, _ = state.stack.shape
+    n_jobs = state.solved.shape[0]
+    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
+
+    # Lanes whose job resolved are cleared (the SOLUTION_FOUND purge).
+    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
+    job_live = (state.job >= 0) & ~state.solved[job_safe]
+    sp = jnp.where(job_live, state.sp, 0)
+    live = sp > 0
+
+    # --- L0: propagate every live top to a fixpoint -------------------------
+    top_idx = jnp.clip(sp - 1, 0, s - 1)
+    tops = state.stack[lane_idx, top_idx]
+    tops = jnp.where(live[:, None, None], tops, 0)  # idle tops are inert zeros
+    tops, sweeps = propagate(tops, geom, config.max_sweeps)
+    status = board_status(tops, geom)
+    solved_tops = status.solved & live
+    contra_tops = status.contradiction & live
+    undecided = live & ~solved_tops & ~contra_tops
+
+    # --- harvest solutions: deterministic lowest-lane winner per job --------
+    scatter_job = jnp.where(solved_tops, state.job, n_jobs)
+    first = jnp.full(n_jobs, n_lanes, jnp.int32).at[scatter_job].min(
+        jnp.where(solved_tops, lane_idx, n_lanes), mode="drop"
+    )
+    newly = (first < n_lanes) & ~state.solved
+    sol_rows = tops[jnp.clip(first, 0, n_lanes - 1)]
+    solution = jnp.where(newly[:, None, None], sol_rows, state.solution)
+    solved = state.solved | newly
+
+    # --- branch: replace parent with `rest`, push `guess` on top ------------
+    onehot = _branch_cell_onehot(tops, config.branch)
+    low = lowest_bit(tops)
+    guess = jnp.where(onehot, low, tops)
+    rest = jnp.where(onehot, tops & ~low, tops)
+
+    full_stack = sp >= s
+    push = undecided & ~full_stack
+    # On overflow: keep DFS-ing the guess in place; the rest-subtree is lost.
+    in_place = jnp.where(
+        undecided[:, None, None], jnp.where(push[:, None, None], rest, guess), tops
+    )
+    slot = jnp.arange(s, dtype=jnp.int32)[None, :]
+    at_top = slot == top_idx[:, None]
+    at_push = slot == sp[:, None]
+    stack = jnp.where(
+        (undecided[:, None] & at_top)[:, :, None, None], in_place[:, None], state.stack
+    )
+    stack = jnp.where(
+        (push[:, None] & at_push)[:, :, None, None], guess[:, None], stack
+    )
+    sp = sp + push.astype(jnp.int32) - (solved_tops | contra_tops).astype(jnp.int32)
+
+    overflow_now = undecided & full_stack
+    overflowed = state.overflowed.at[
+        jnp.where(overflow_now, state.job, n_jobs)
+    ].set(True, mode="drop")
+
+    nodes = state.nodes.at[jnp.where(undecided, state.job, n_jobs)].add(
+        jnp.where(undecided, jnp.int32(1), jnp.int32(0)), mode="drop"
+    )
+
+    # --- work stealing ------------------------------------------------------
+    job_live = (state.job >= 0) & ~solved[job_safe]
+    sp = jnp.where(job_live, sp, 0)
+    n_steals = jnp.int32(0)
+    job_arr = state.job
+    if config.steal:
+        stack, sp, job_arr, n_steals = _steal(stack, sp, job_arr, job_live)
+
+    return Frontier(
+        stack=stack,
+        sp=sp,
+        job=job_arr,
+        solved=solved,
+        solution=solution,
+        overflowed=overflowed,
+        nodes=nodes,
+        steps=state.steps + 1,
+        sweeps=state.sweeps + sweeps,
+        expansions=state.expansions + jnp.sum(undecided).astype(jnp.int32),
+        steals=state.steals + n_steals,
+    )
+
+
+def frontier_live(state: Frontier) -> jax.Array:
+    """bool[L]: lanes still holding unexplored work for an unsolved job."""
+    n_jobs = state.solved.shape[0]
+    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
+    return (state.sp > 0) & (state.job >= 0) & ~state.solved[job_safe]
+
+
+def run_frontier(state: Frontier, geom: Geometry, config: SolverConfig) -> Frontier:
+    """Drive steps until every job resolves (solved or search space exhausted)."""
+
+    def cond(st: Frontier):
+        return jnp.any(frontier_live(st)) & (st.steps < config.max_steps)
+
+    return jax.lax.while_loop(cond, lambda s: frontier_step(s, geom, config), state)

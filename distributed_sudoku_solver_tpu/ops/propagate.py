@@ -1,0 +1,127 @@
+"""Constraint propagation as batched boolean tensor ops.
+
+This replaces the reference's only inference rule — the per-guess
+``is_valid`` membership scan (``/root/reference/utils.py:27-55``) — with two
+much stronger vectorized rules applied to the whole board at once:
+
+* **elimination** (a decided cell removes its digit from its row/col/box), and
+* **hidden singles** (a digit with exactly one remaining home in a unit is
+  placed there),
+
+iterated to a fixpoint inside ``lax.while_loop``.  This is where the
+~10^2-10^4x search-space reduction over the reference's blind DFS comes from
+(SURVEY.md §6): most easy boards solve with zero guesses, hard 17-clue boards
+need orders of magnitude fewer branch nodes.
+
+Everything here works on arbitrary leading batch dims: shape [..., n, n].
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import (
+    from_boxes,
+    is_single,
+    once_twice_reduce,
+    or_reduce,
+    to_boxes,
+)
+
+_UNIT_AXES = ("row", "col", "box")
+
+
+def _unit_views(cand: jax.Array, geom: Geometry):
+    """Yield (view, undo) pairs so each unit type is a reduction over axis -1."""
+    yield cand, lambda x: x  # rows: cells of a row are contiguous in axis -1
+    yield jnp.swapaxes(cand, -1, -2), lambda x: jnp.swapaxes(x, -1, -2)
+    yield to_boxes(cand, geom), lambda x: from_boxes(x, geom)
+
+
+def propagate_sweep(cand: jax.Array, geom: Geometry) -> jax.Array:
+    """One propagation sweep: eliminate decided digits, then place hidden singles."""
+    single = is_single(cand)
+    decided = jnp.where(single, cand, jnp.uint32(0))
+
+    # --- elimination: remove every decided digit from its three units -------
+    seen = jnp.zeros_like(cand)
+    for view, undo in _unit_views(decided, geom):
+        unit_or = or_reduce(view, -1)[..., None]
+        seen = seen | undo(jnp.broadcast_to(unit_or, view.shape))
+    # Decided cells keep their own bit; undecided cells lose all seen bits.
+    cand = jnp.where(single, cand, cand & ~seen)
+
+    # --- hidden singles: a digit with a unique home in a unit is forced -----
+    forced = jnp.zeros_like(cand)
+    for view, undo in _unit_views(cand, geom):
+        once, twice = once_twice_reduce(view, -1)
+        unique = (once & ~twice)[..., None]
+        forced = forced | undo(view & jnp.broadcast_to(unique, view.shape))
+    # A nonzero `forced` is a sound restriction: each forced bit *must* be this
+    # cell's value (two different forced bits in one cell is an unsat board and
+    # stays detectable downstream).  Never touch already-decided cells.
+    cand = jnp.where(~single & (forced != 0), forced, cand)
+    return cand
+
+
+class BoardStatus(NamedTuple):
+    solved: jax.Array  # bool[...]: fully decided and consistent
+    contradiction: jax.Array  # bool[...]: provably unsatisfiable
+
+
+def board_status(cand: jax.Array, geom: Geometry) -> BoardStatus:
+    """Classify each board: solved / contradiction / (neither = undecided).
+
+    The consistency rules double as the (fixed) re-implementation of the
+    reference's broken ``Sudoku.check`` (``/root/reference/sudoku.py:48-94``,
+    which NameErrors on valid grids — SURVEY.md §2.5 #1):
+      * no cell empty of candidates,
+      * no two decided cells in a unit share a digit,
+      * every digit retains at least one home in every unit.
+    """
+    single = is_single(cand)
+    decided = jnp.where(single, cand, jnp.uint32(0))
+    full = jnp.uint32(geom.full_mask)
+
+    empty_cell = jnp.any(cand == 0, axis=(-1, -2))
+    dup = jnp.zeros(cand.shape[:-2], dtype=bool)
+    uncovered = jnp.zeros(cand.shape[:-2], dtype=bool)
+    for view, _ in _unit_views(decided, geom):
+        unit_or = or_reduce(view, -1)
+        unit_sum = jnp.sum(view, axis=-1)  # singleton masks: sum==or iff distinct
+        dup = dup | jnp.any(unit_sum != unit_or, axis=-1)
+    for view, _ in _unit_views(cand, geom):
+        uncovered = uncovered | jnp.any(or_reduce(view, -1) != full, axis=-1)
+
+    contradiction = empty_cell | dup | uncovered
+    solved = jnp.all(single, axis=(-1, -2)) & ~contradiction
+    return BoardStatus(solved=solved, contradiction=contradiction)
+
+
+def propagate(
+    cand: jax.Array, geom: Geometry, max_sweeps: int = 64
+) -> tuple[jax.Array, jax.Array]:
+    """Sweep to a fixpoint (bounded by ``max_sweeps``); returns (cand, n_sweeps).
+
+    The loop condition is batch-global ("any board changed"), keeping the whole
+    batch in one ``lax.while_loop`` — boards that stabilized early are cheap
+    no-ops in later sweeps because every op is a fused elementwise pass.
+    """
+
+    def cond(state):
+        _, changed, sweeps = state
+        return changed & (sweeps < max_sweeps)
+
+    def body(state):
+        cur, _, sweeps = state
+        nxt = propagate_sweep(cur, geom)
+        return nxt, jnp.any(nxt != cur), sweeps + 1
+
+    cand, _, sweeps = jax.lax.while_loop(
+        cond, body, (cand, jnp.bool_(True), jnp.int32(0))
+    )
+    return cand, sweeps

@@ -1,0 +1,84 @@
+"""Single-device batched solve: the `POST /solve` compute path, jit-compiled.
+
+Replaces the reference's ``perform_solving`` + ``solve_sudoku`` pair
+(``/root/reference/DHT_Node.py:424-538``): instead of one recursive search
+per node with a per-recursion socket poll, a whole batch of jobs shares one
+lane-stack frontier and one ``lax.while_loop``.  The return contract is
+richer than the reference's (which can only ever say "solved"): each job
+resolves to solved, *proven unsatisfiable* (every subtree exhausted, nothing
+dropped), or unknown (step budget hit / stack overflow) — detected and
+reported instead of hanging forever like a lost UDP TASK (SURVEY.md §2.5 #7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid, encode_grid
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    frontier_live,
+    init_frontier,
+    run_frontier,
+)
+
+
+class SolveResult(NamedTuple):
+    solution: jax.Array  # int32[J, n, n]; all-zero rows for unsat/unknown jobs
+    solved: jax.Array  # bool[J]
+    unsat: jax.Array  # bool[J]: proven unsatisfiable
+    overflowed: jax.Array  # bool[J]: a subtree was dropped (stack overflow)
+    nodes: jax.Array  # int32[J] branch nodes expanded ("validations" analog)
+    steps: jax.Array  # int32 frontier rounds
+    sweeps: jax.Array  # int32 total propagation sweeps
+    expansions: jax.Array  # int32 total branch expansions
+    steals: jax.Array  # int32 total lane-to-lane work steals
+
+
+def _finalize(state: Frontier) -> SolveResult:
+    n_jobs = state.solved.shape[0]
+    live = frontier_live(state)
+    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
+    job_has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(live, mode="drop")
+    unsat = ~state.solved & ~job_has_work & ~state.overflowed
+    solution = jnp.where(
+        state.solved[:, None, None], decode_grid(state.solution), jnp.int32(0)
+    )
+    return SolveResult(
+        solution=solution,
+        solved=state.solved,
+        unsat=unsat,
+        overflowed=state.overflowed,
+        nodes=state.nodes,
+        steps=state.steps,
+        sweeps=state.sweeps,
+        expansions=state.expansions,
+        steals=state.steals,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config"))
+def solve_batch(
+    grids: jax.Array, geom: Geometry, config: SolverConfig = SolverConfig()
+) -> SolveResult:
+    """Solve int grids [J, n, n] (0 = empty); one compiled program per (J, geom, config)."""
+    cand0 = encode_grid(grids, geom)
+    state = init_frontier(cand0, config)
+    state = run_frontier(state, geom, config)
+    return _finalize(state)
+
+
+def solve_one(grid, geom: Geometry, config: SolverConfig = SolverConfig()):
+    """Convenience: solve a single board; returns (np solution | None, SolveResult)."""
+    grids = jnp.asarray(np.asarray(grid)[None])
+    res = solve_batch(grids, geom, config)
+    solved = bool(res.solved[0])
+    sol = np.asarray(res.solution[0]) if solved else None
+    return sol, res

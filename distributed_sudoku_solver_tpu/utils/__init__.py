@@ -1,0 +1,4 @@
+from distributed_sudoku_solver_tpu.utils.oracle import (  # noqa: F401
+    solve_oracle,
+    is_valid_solution,
+)

@@ -1,0 +1,13 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+The TPU-world replacement for the reference's loopback-multiprocess testing
+methodology (SURVEY.md §4): real shard_map collectives on fake devices.
+Must run before jax is imported anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)

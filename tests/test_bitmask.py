@@ -1,0 +1,107 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import (
+    SUDOKU_4,
+    SUDOKU_6,
+    SUDOKU_9,
+    SUDOKU_16,
+    SUDOKU_25,
+    Geometry,
+    geometry_for_size,
+)
+from distributed_sudoku_solver_tpu.ops.bitmask import (
+    decode_grid,
+    encode_grid,
+    from_boxes,
+    lowest_bit,
+    mask_to_value,
+    once_twice_reduce,
+    or_reduce,
+    popcount,
+    to_boxes,
+)
+
+
+def test_geometry_props():
+    assert SUDOKU_9.n == 9 and SUDOKU_9.full_mask == 0x1FF
+    assert SUDOKU_25.n == 25 and SUDOKU_25.full_mask == (1 << 25) - 1
+    assert SUDOKU_6.n_vboxes == 3 and SUDOKU_6.n_hboxes == 2
+    assert geometry_for_size(9) is SUDOKU_9
+    with pytest.raises(ValueError):
+        Geometry(6, 6)  # 36 digits exceed uint32
+    with pytest.raises(ValueError):
+        geometry_for_size(7)
+
+
+def test_popcount_lowest_bit():
+    x = jnp.asarray(np.arange(0, 1 << 10, dtype=np.uint32))
+    pc = np.asarray(popcount(x))
+    lb = np.asarray(lowest_bit(x))
+    for v in range(1, 1 << 10):
+        assert pc[v] == bin(v).count("1")
+        assert lb[v] == v & -v
+    assert lb[0] == 0
+
+
+def test_encode_decode_roundtrip():
+    rng = np.random.default_rng(0)
+    for geom in (SUDOKU_4, SUDOKU_9, SUDOKU_16, SUDOKU_25):
+        grid = rng.integers(0, geom.n + 1, size=(geom.n, geom.n))
+        cand = encode_grid(grid, geom)
+        dec = np.asarray(decode_grid(cand))
+        # Given cells decode back; empty cells decode to 0 (full mask != single)
+        assert np.array_equal(dec[grid > 0], grid[grid > 0])
+        assert np.all(dec[grid == 0] == (0 if geom.n > 1 else dec[grid == 0]))
+        assert np.asarray(cand)[grid == 0][0] == geom.full_mask if (grid == 0).any() else True
+
+
+def test_mask_to_value_all_digits():
+    for geom in (SUDOKU_9, SUDOKU_25):
+        masks = jnp.asarray(np.uint32(1) << np.arange(geom.n, dtype=np.uint32))
+        vals = np.asarray(mask_to_value(masks))
+        assert np.array_equal(vals, np.arange(1, geom.n + 1))
+
+
+def test_or_reduce_matches_numpy():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 1 << 25, size=(5, 9, 9)).astype(np.uint32)
+    for ax in range(3):
+        got = np.asarray(or_reduce(jnp.asarray(x), ax))
+        want = np.bitwise_or.reduce(x, axis=ax)
+        assert np.array_equal(got, want)
+
+
+def test_once_twice_reduce():
+    rng = np.random.default_rng(2)
+    for width in (3, 9, 16, 25):
+        x = rng.integers(0, 1 << 20, size=(7, width)).astype(np.uint32)
+        once, twice = once_twice_reduce(jnp.asarray(x), -1)
+        once, twice = np.asarray(once), np.asarray(twice)
+        for row in range(7):
+            counts = np.zeros(32, dtype=int)
+            for v in x[row]:
+                for b in range(32):
+                    counts[b] += (int(v) >> b) & 1
+            want_once = sum(1 << b for b in range(32) if counts[b] >= 1)
+            want_twice = sum(1 << b for b in range(32) if counts[b] >= 2)
+            assert once[row] == want_once
+            assert twice[row] == want_twice
+
+
+def test_boxes_roundtrip_and_grouping():
+    for geom in (SUDOKU_4, SUDOKU_6, SUDOKU_9, SUDOKU_16):
+        n = geom.n
+        grid = jnp.asarray(np.arange(n * n, dtype=np.uint32).reshape(n, n))
+        boxes = np.asarray(to_boxes(grid, geom))
+        # Box b, cell k should be cell (row, col) of box b in row-major order.
+        for b in range(n):
+            br, bc = divmod(b, geom.n_hboxes)
+            for k in range(n):
+                kr, kc = divmod(k, geom.box_w)
+                r = br * geom.box_h + kr
+                c = bc * geom.box_w + kc
+                assert boxes[b, k] == r * n + c
+        back = np.asarray(from_boxes(jnp.asarray(boxes), geom))
+        assert np.array_equal(back, np.asarray(grid))

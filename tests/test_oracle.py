@@ -1,0 +1,74 @@
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_4, SUDOKU_9, SUDOKU_16
+from distributed_sudoku_solver_tpu.utils.oracle import (
+    count_solutions,
+    is_consistent_partial,
+    is_valid_solution,
+    solve_oracle,
+)
+from distributed_sudoku_solver_tpu.utils.puzzles import (
+    EASY_9,
+    HARD_9,
+    make_puzzle,
+    parse_line,
+    puzzle_batch,
+    random_solution,
+    to_line,
+)
+
+
+def test_oracle_solves_easy():
+    sol = solve_oracle(EASY_9)
+    assert is_valid_solution(sol)
+    assert np.array_equal(sol[EASY_9 > 0], EASY_9[EASY_9 > 0])
+
+
+def test_oracle_detects_unsat():
+    bad = EASY_9.copy()
+    bad[0, 0] = bad[0, 1] = 5
+    assert solve_oracle(bad) is None
+    assert not is_consistent_partial(bad)
+
+
+def test_validator_rejects_bad_grids():
+    sol = solve_oracle(EASY_9)
+    assert is_valid_solution(sol)
+    wrong = sol.copy()
+    wrong[0, 0], wrong[0, 1] = wrong[0, 1], wrong[0, 0]
+    assert not is_valid_solution(wrong)
+    assert not is_valid_solution(np.zeros((9, 9), int))
+
+
+def test_hard_boards_are_proper_puzzles():
+    # hard[2] (17-clue) uniqueness takes ~1 min via count_solutions; the
+    # batched solver covers it instead (test_solve).  Check the Inkala pair.
+    for p in HARD_9[:2]:
+        assert is_consistent_partial(p)
+        assert count_solutions(p, limit=2) == 1
+
+
+def test_generator_roundtrip_and_uniqueness():
+    for geom, seed in ((SUDOKU_4, 0), (SUDOKU_9, 5)):
+        sol = random_solution(geom, seed)
+        assert is_valid_solution(sol, geom)
+        p = make_puzzle(geom, seed, n_clues=geom.n_cells // 3)
+        assert count_solutions(p, geom, limit=2) == 1
+        got = solve_oracle(p, geom)
+        assert np.array_equal(got, sol) or is_valid_solution(got, geom)
+
+
+def test_generator_determinism():
+    a = puzzle_batch(SUDOKU_9, 3, seed=11)
+    b = puzzle_batch(SUDOKU_9, 3, seed=11)
+    assert np.array_equal(a, b)
+
+
+def test_parse_line_roundtrip_base36():
+    sol16 = random_solution(SUDOKU_16, 1)
+    line = to_line(sol16)
+    assert len(line) == 256
+    assert np.array_equal(parse_line(line, 16), sol16)
+    with pytest.raises(ValueError):
+        parse_line("123", 9)

@@ -1,0 +1,60 @@
+import numpy as np
+import jax.numpy as jnp
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_4, SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid, encode_grid
+from distributed_sudoku_solver_tpu.ops.propagate import (
+    board_status,
+    propagate,
+    propagate_sweep,
+)
+from distributed_sudoku_solver_tpu.utils.oracle import solve_oracle
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, random_solution
+
+
+def test_easy_solves_by_propagation_alone():
+    cand, sweeps = propagate(encode_grid(EASY_9, SUDOKU_9), SUDOKU_9)
+    st = board_status(cand, SUDOKU_9)
+    assert bool(st.solved) and not bool(st.contradiction)
+    assert int(sweeps) > 0
+    assert np.array_equal(np.asarray(decode_grid(cand)), solve_oracle(EASY_9))
+
+
+def test_propagation_soundness_never_kills_true_solution():
+    # Property (SURVEY.md §4 #1): for puzzles carved from a known solution,
+    # the solution digit survives every sweep in every cell.
+    rng = np.random.default_rng(3)
+    for seed in range(5):
+        sol = random_solution(SUDOKU_9, seed)
+        puzzle = sol * (rng.random(sol.shape) < 0.4)
+        cand = encode_grid(puzzle, SUDOKU_9)
+        sol_bits = jnp.uint32(1) << jnp.asarray(sol - 1, dtype=jnp.uint32)
+        for _ in range(10):
+            cand = propagate_sweep(cand, SUDOKU_9)
+            assert bool(jnp.all(cand & sol_bits == sol_bits))
+
+
+def test_board_status_detects_contradictions():
+    geom = SUDOKU_4
+    # duplicate given in a row
+    bad = np.zeros((4, 4), dtype=np.int64)
+    bad[0, 0] = bad[0, 3] = 2
+    st = board_status(encode_grid(bad, geom), geom)
+    assert bool(st.contradiction) and not bool(st.solved)
+
+    # solved board is solved
+    sol = random_solution(geom, 0)
+    st = board_status(encode_grid(sol, geom), geom)
+    assert bool(st.solved) and not bool(st.contradiction)
+
+    # empty board is neither
+    st = board_status(encode_grid(np.zeros((4, 4), int), geom), geom)
+    assert not bool(st.solved) and not bool(st.contradiction)
+
+
+def test_propagate_batched_leading_dims():
+    batch = np.stack([EASY_9, np.zeros((9, 9), int)])
+    cand, _ = propagate(encode_grid(batch, SUDOKU_9), SUDOKU_9)
+    st = board_status(cand, SUDOKU_9)
+    assert list(np.asarray(st.solved)) == [True, False]
+    assert not np.asarray(st.contradiction).any()

@@ -1,0 +1,128 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import (
+    SUDOKU_4,
+    SUDOKU_9,
+    SUDOKU_16,
+    SUDOKU_25,
+)
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch, solve_one
+from distributed_sudoku_solver_tpu.utils.oracle import (
+    is_valid_solution,
+    solve_oracle,
+)
+from distributed_sudoku_solver_tpu.utils.puzzles import (
+    EASY_9,
+    HARD_9,
+    make_puzzle,
+    puzzle_batch,
+    random_solution,
+)
+
+
+def _check_matches_oracle(puzzles, res, geom):
+    for i, p in enumerate(puzzles):
+        assert bool(res.solved[i]), f"puzzle {i} unsolved"
+        sol = np.asarray(res.solution[i])
+        assert is_valid_solution(sol, geom)
+        assert np.array_equal(sol[p > 0], p[p > 0]), "clues not preserved"
+        assert np.array_equal(sol, solve_oracle(p, geom)), f"puzzle {i} != oracle"
+
+
+def test_embedded_corpus_bit_exact_vs_oracle():
+    batch = np.stack([EASY_9] + HARD_9)
+    res = solve_batch(jnp.asarray(batch), SUDOKU_9)
+    _check_matches_oracle(batch, res, SUDOKU_9)
+    assert not np.asarray(res.overflowed).any()
+
+
+def test_generated_batch_bit_exact_vs_oracle():
+    batch = puzzle_batch(SUDOKU_9, 8, seed=100, n_clues=24)
+    res = solve_batch(jnp.asarray(batch), SUDOKU_9)
+    _check_matches_oracle(batch, res, SUDOKU_9)
+
+
+def test_reference_branch_order_mode():
+    batch = np.stack([EASY_9] + HARD_9)
+    cfg = SolverConfig(branch="first")
+    res = solve_batch(jnp.asarray(batch), SUDOKU_9, cfg)
+    _check_matches_oracle(batch, res, SUDOKU_9)
+
+
+def test_batch_equals_per_puzzle():
+    # SURVEY.md §4 #2: vmap/batch results must equal per-puzzle results.
+    batch = puzzle_batch(SUDOKU_9, 4, seed=7, n_clues=26)
+    res = solve_batch(jnp.asarray(batch), SUDOKU_9)
+    for i, p in enumerate(batch):
+        sol, one = solve_one(p, SUDOKU_9)
+        assert bool(one.solved[0])
+        assert np.array_equal(sol, np.asarray(res.solution[i]))
+
+
+def test_unsat_proven():
+    bad = EASY_9.copy()
+    bad[0, 0] = bad[0, 1] = 5
+    empty_unsat = np.zeros((9, 9), int)
+    empty_unsat[0, :8] = range(1, 9)
+    empty_unsat[1, 8] = 9  # cell (0,8) has no candidate left
+    for grid in (bad, empty_unsat):
+        res = solve_batch(jnp.asarray(grid[None]), SUDOKU_9)
+        assert not bool(res.solved[0])
+        assert bool(res.unsat[0])
+        assert solve_oracle(grid) is None
+
+
+def test_multi_solution_returns_some_valid_solution():
+    # Two empty cells swappable -> 2 solutions; any valid one is acceptable
+    # in fast mode (unique-solution puzzles are bit-exact by construction).
+    sol = random_solution(SUDOKU_9, 17)
+    p = sol.copy()
+    # blank a pair of cells that forms a rectangle with two digits
+    p[0, 0] = p[0, 1] = p[1, 0] = p[1, 1] = 0
+    res = solve_batch(jnp.asarray(p[None]), SUDOKU_9)
+    assert bool(res.solved[0])
+    assert is_valid_solution(np.asarray(res.solution[0]), SUDOKU_9)
+
+
+def test_empty_board_all_geometries():
+    for geom in (SUDOKU_4, SUDOKU_9):
+        empty = np.zeros((geom.n, geom.n), int)
+        sol, res = solve_one(empty, geom)
+        assert bool(res.solved[0])
+        assert is_valid_solution(sol, geom)
+
+
+def test_16x16():
+    geom = SUDOKU_16
+    batch = np.stack(
+        [make_puzzle(geom, s, n_clues=140, unique=False) for s in range(2)]
+    )
+    res = solve_batch(jnp.asarray(batch), geom)
+    for i, p in enumerate(batch):
+        assert bool(res.solved[i])
+        sol = np.asarray(res.solution[i])
+        assert is_valid_solution(sol, geom)
+        assert np.array_equal(sol[p > 0], p[p > 0])
+
+
+@pytest.mark.slow
+def test_25x25():
+    geom = SUDOKU_25
+    p = make_puzzle(geom, 0, n_clues=420, unique=False)
+    sol, res = solve_one(p, geom, SolverConfig(stack_slots=192))
+    assert bool(res.solved[0])
+    assert is_valid_solution(sol, geom)
+    assert np.array_equal(sol[p > 0], p[p > 0])
+
+
+def test_nodes_counter_populated():
+    batch = np.stack(HARD_9[:2])
+    res = solve_batch(jnp.asarray(batch), SUDOKU_9)
+    nodes = np.asarray(res.nodes)
+    assert (nodes >= 0).all()
+    assert int(res.expansions) == nodes.sum()
+    # Inkala boards need actual search
+    assert nodes.sum() > 0
