@@ -1,0 +1,62 @@
+"""Headline benchmark: hard-9x9 throughput (boards solved/s) on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Baseline: the reference solves one easy 9x9 via `POST /solve` in 3.13 s on
+this container (BASELINE.md, measured from /root/reference/DHT_Node.py live)
+— an effective 0.3195 boards/s/node.  ``vs_baseline`` is our boards/s over
+that figure, i.e. a direct end-to-end speedup multiple on the same workload
+family (and our bench set is *harder*: 17-28 clue boards, not easy ones).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_BOARDS_PER_S = 1.0 / 3.13  # reference: easy 9x9 end-to-end (BASELINE.md)
+
+
+def main() -> None:
+    import jax
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, puzzle_batch
+
+    batch = 512
+    gen = puzzle_batch(SUDOKU_9, batch - len(HARD_9), seed=7, n_clues=24)
+    grids = np.concatenate([np.stack(HARD_9), gen]).astype(np.int32)
+
+    cfg = SolverConfig(min_lanes=grids.shape[0], stack_slots=64)
+    # Warm-up: compile + first run.
+    res = solve_batch(grids, SUDOKU_9, cfg)
+    jax.block_until_ready(res)
+
+    n_iters = 5
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        res = solve_batch(grids, SUDOKU_9, cfg)
+        jax.block_until_ready(res)
+    dt = (time.perf_counter() - t0) / n_iters
+
+    solved = int(np.asarray(res.solved).sum())
+    boards_per_s = solved / dt
+    out = {
+        "metric": "hard9x9_boards_per_s_per_chip",
+        "value": round(boards_per_s, 2),
+        "unit": "boards/s",
+        "vs_baseline": round(boards_per_s / BASELINE_BOARDS_PER_S, 1),
+        "batch": grids.shape[0],
+        "solved": solved,
+        "wall_s_per_batch": round(dt, 4),
+        "device": str(jax.devices()[0].platform),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,18 +5,19 @@ reference P2P distributed Sudoku solver (see SURVEY.md): batched bitmask
 constraint propagation + speculative-parallel search on TPU, sharded over a
 device mesh, fronted by the reference-compatible HTTP API.
 
-Layer map (TPU-native re-design of SURVEY.md §1; layers land bottom-up —
-anything not present in the tree yet is marked [planned]):
+Layer map (TPU-native re-design of SURVEY.md §1):
 
   L0  compute kernel   ops/            jit-compiled bitmask propagation + frontier step
   L2  scheduler        ops/solve.py    frontier tensor IS the work pool; branching,
                                        stealing and cancellation are in-graph
   L2' multi-chip       parallel/       shard_map over a Mesh; steal/solved
                                        broadcast as ICI collectives
-  L3  membership/FT    runtime/cluster.py   typed TCP control plane (join, heartbeat,
+  L3  membership/FT    cluster/        typed TCP control plane (join, heartbeat,
                                        failure detection, re-dispatch)
-  L4  client API       runtime/server.py    POST /solve, GET /stats, GET /network
+  L4  client API       serving/        engine job queue + POST /solve, GET /stats,
+                                       GET /network
   L5  CLI/config       cli.py, models/geometry.py
+  --  native oracle    native/         C++ bit-exact CPU reference (ctypes-bound)
 """
 
 __version__ = "0.1.0"

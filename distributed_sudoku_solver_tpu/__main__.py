@@ -1,0 +1,3 @@
+from distributed_sudoku_solver_tpu.cli import main
+
+main()

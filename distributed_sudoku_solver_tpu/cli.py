@@ -1,0 +1,97 @@
+"""Node CLI — flag-compatible superset of the reference's entry point.
+
+The reference boots one node with ``-p`` (HTTP port), ``-s`` (P2P port),
+``-a`` (anchor host:port), ``-d`` (handicap ms) — ``/root/reference/
+DHT_Node.py:623-628``.  Same four knobs here, same meanings, plus the TPU
+knobs the reference could never expose (mesh size, lanes, stack depth).
+
+The handicap is kept as a *slow-node simulator* for observing cluster load
+balancing, exactly the reference's purpose for it (SURVEY.md §5.3): an
+artificial per-job sleep in the host engine.  It never touches the device
+path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from distributed_sudoku_solver_tpu.cluster.node import ClusterConfig, ClusterNode
+from distributed_sudoku_solver_tpu.cluster.wire import parse_addr
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.http import ApiServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="distributed_sudoku_solver_tpu",
+        description="TPU-native distributed constraint-satisfaction node",
+    )
+    ap.add_argument("-p", "--http-port", type=int, default=8000)
+    ap.add_argument("-s", "--p2p-port", type=int, default=7000)
+    ap.add_argument("-a", "--anchor", type=str, default=None, help="host:port of any cluster member")
+    ap.add_argument("-d", "--handicap", type=float, default=0, help="artificial per-job delay, ms (slow-node simulator)")
+    ap.add_argument("--host", type=str, default="0.0.0.0", help="bind address")
+    ap.add_argument(
+        "--advertise-host",
+        type=str,
+        default=None,
+        help="address peers dial (default: auto-detected routable IP)",
+    )
+    ap.add_argument("--lanes", type=int, default=0, help="frontier lanes (0 = auto)")
+    ap.add_argument("--stack-slots", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")
+    ap.add_argument("--heartbeat-s", type=float, default=1.0)
+    return ap
+
+
+def make_engine(args) -> SolverEngine:
+    cfg = SolverConfig(lanes=args.lanes, stack_slots=args.stack_slots)
+    solve_fn = None
+    if args.sharded:
+        from distributed_sudoku_solver_tpu.parallel import solve_batch_sharded
+
+        solve_fn = lambda grids, geom, c: solve_batch_sharded(grids, geom, c)  # noqa: E731
+    engine = SolverEngine(config=cfg, max_batch=args.max_batch, solve_fn=solve_fn)
+    if args.handicap:
+        inner = engine._solve_fn
+        delay = args.handicap / 1000.0
+
+        def slow(grids, geom, c):
+            time.sleep(delay)
+            return inner(grids, geom, c)
+
+        engine._solve_fn = slow
+    return engine
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    engine = make_engine(args).start()
+    node = ClusterNode(
+        engine,
+        host=args.host,
+        port=args.p2p_port,
+        anchor=parse_addr(args.anchor) if args.anchor else None,
+        config=ClusterConfig(heartbeat_s=args.heartbeat_s),
+        advertise_host=args.advertise_host,
+    ).start()
+    api = ApiServer(node, host=args.host, port=args.http_port, verbose=True).start()
+    print(
+        f"node up: http={args.host}:{api.port} p2p={node.addr_s} "
+        f"coordinator={node.coordinator}"
+    )
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("stopping...")
+        api.stop()
+        node.stop()
+        engine.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,466 @@
+"""Cluster node: coordinator membership + heartbeat + job dispatch + recovery.
+
+Host-level re-design of the reference's overlay layer (SURVEY.md §1 L3,
+§2.1 #8-#10) for the TPU world: each *node* is a host driving its own chip
+mesh (the data plane lives in ``parallel/``), and the cluster layer moves
+whole jobs, not subtrees — intra-job parallelism is the mesh's business.
+
+Capability map (reference -> here):
+
+* coordinator-mediated join (``/root/reference/DHT_Node.py:260-330``) ->
+  JOIN_REQ forwarded to the coordinator, which appends to the member list
+  and broadcasts UPDATE_NETWORK; ring positions (predecessor/successor) are
+  *derived from list order* on every node, eliminating the reference's
+  separate UPDATE_PREDECESSOR/UPDATE_NEIGHBOR splice messages and the
+  inconsistency windows between them.
+* heartbeat + 2x-timeout detection (``:43-62,158-163``) -> each node
+  heartbeats its ring successor and watches its predecessor's arrivals.
+* coordinator-led repair + self-promotion (``:167-199``) -> same roles:
+  detector reports NODE_FAILED; the dead coordinator's successor-detector
+  self-promotes (exactly one detector per corpse, so promotion is unique).
+* re-execution from the delegator's ledger (``:47,497,509,201-209``) ->
+  every forwarded job stays in ``self._ledger`` until its SOLUTION arrives;
+  when a member leaves the network view, its ledger entries re-run locally.
+* NEEDWORK load balancing (``:246-254``) -> receiver-independent
+  least-outstanding dispatch at submit time (jobs are sized uniformly by
+  the engine's batching, so proactive balance replaces reactive stealing
+  at this layer; reactive stealing lives on-device, ``ops/frontier.py``).
+* STATS_REQ 1 s gather sleep (``:566-598``) -> synchronous request/reply
+  fan-out with per-peer timeouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from distributed_sudoku_solver_tpu.cluster import wire
+from distributed_sudoku_solver_tpu.cluster.wire import Addr, WireError, addr_str
+from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
+
+
+def local_ip() -> str:
+    """Best-effort routable local address (UDP connect sends no packets)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    heartbeat_s: float = 1.0
+    fail_factor: float = 3.0  # declare dead after fail_factor * heartbeat_s
+    io_timeout_s: float = 5.0
+    stats_timeout_s: float = 2.0
+
+
+class ClusterNode:
+    """One host in the solver cluster; wraps a local SolverEngine."""
+
+    def __init__(
+        self,
+        engine: SolverEngine,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        anchor: Optional[Addr] = None,
+        config: ClusterConfig = ClusterConfig(),
+        advertise_host: Optional[str] = None,
+    ):
+        """``host`` is the bind address; ``advertise_host`` is the identity
+        other members dial (defaults to ``host``, which is only correct for
+        single-machine clusters — multi-host deployments must advertise a
+        routable address, e.g. from :func:`local_ip`)."""
+        self.engine = engine
+        self.config = config
+        self._listener = socket.create_server((host, port))
+        bound_port = self._listener.getsockname()[1]
+        adv = advertise_host or host
+        if adv in ("0.0.0.0", "::"):
+            adv = local_ip()
+        self.addr: Addr = (adv, bound_port)
+        self.addr_s = addr_str(self.addr)
+        self.anchor = anchor
+
+        self._lock = threading.RLock()
+        self.network: list[str] = [self.addr_s]  # list order defines the ring
+        self.coordinator: str = self.addr_s
+        self._last_hb = time.monotonic()
+        self._ledger: dict[str, dict] = {}  # uuid -> {grid, member, job}
+        self._outstanding: dict[str, int] = {}  # member -> in-flight count
+        self._rr = 0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ClusterNode":
+        for target, name in ((self._accept_loop, "accept"), (self._hb_loop, "hb")):
+            t = threading.Thread(target=target, daemon=True, name=f"{name}@{self.addr_s}")
+            t.start()
+            self._threads.append(t)
+        if self.anchor is not None:
+            wire.send_msg(
+                self.anchor,
+                {"method": "JOIN_REQ", "addr": self.addr_s},
+                self.config.io_timeout_s,
+            )
+        return self
+
+    def stop(self, graceful: bool = True) -> None:
+        """Leave the ring (graceful drain analog of ``DHT_Node.stop``, :137-156)."""
+        self._stop.set()
+        if graceful and self.coordinator != self.addr_s:
+            try:
+                wire.send_msg(
+                    wire.parse_addr(self.coordinator),
+                    {"method": "LEAVE", "addr": self.addr_s},
+                    self.config.io_timeout_s,
+                )
+            except WireError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        """Abrupt death for fault-injection tests: no LEAVE, just silence."""
+        self.stop(graceful=False)
+
+    # -- ring derivation -----------------------------------------------------
+    def _ring(self) -> tuple[Optional[str], Optional[str]]:
+        with self._lock:
+            if len(self.network) < 2 or self.addr_s not in self.network:
+                return None, None
+            i = self.network.index(self.addr_s)
+            pred = self.network[(i - 1) % len(self.network)]
+            succ = self.network[(i + 1) % len(self.network)]
+            return pred, succ
+
+    # -- background loops ----------------------------------------------------
+    def _accept_loop(self) -> None:
+        self._listener.listen()
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                conn.settimeout(self.config.io_timeout_s)
+                msg = wire.recv_msg(conn)
+                self._handle(msg, conn)
+            except (WireError, OSError, ValueError, KeyError) as e:
+                # Malformed or interrupted control traffic is logged-and-dropped;
+                # reliability comes from sender-side errors, not server retries.
+                if not self._stop.is_set():
+                    print(f"[{self.addr_s}] bad message: {e!r}")
+
+    def _hb_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self.config.heartbeat_s)
+            pred, succ = self._ring()
+            if succ is None:
+                with self._lock:
+                    self._last_hb = time.monotonic()
+                continue
+            try:
+                wire.send_msg(
+                    wire.parse_addr(succ),
+                    {"method": "HEARTBEAT", "from": self.addr_s},
+                    self.config.io_timeout_s,
+                )
+            except WireError:
+                pass  # successor's own detector handles its death
+            limit = self.config.heartbeat_s * self.config.fail_factor
+            with self._lock:
+                expired = time.monotonic() - self._last_hb > limit
+            if expired and pred is not None:
+                self._on_peer_dead(pred)
+
+    # -- message handling ----------------------------------------------------
+    def _handle(self, msg: dict, conn: socket.socket) -> None:
+        method = msg["method"]
+        if method == "JOIN_REQ":
+            self._on_join_req(msg["addr"])
+        elif method == "UPDATE_NETWORK":
+            self._on_update_network(list(msg["network"]), msg["coordinator"])
+        elif method == "HEARTBEAT":
+            with self._lock:
+                self._last_hb = time.monotonic()
+        elif method == "NODE_FAILED":
+            self._on_node_failed(msg["addr"])
+        elif method == "LEAVE":
+            self._on_node_failed(msg["addr"])  # same repair path, no suspicion
+        elif method == "TASK":
+            self._on_task(msg)
+        elif method == "SOLUTION":
+            self._on_solution(msg)
+        elif method == "CANCEL":
+            self.engine.cancel(msg["uuid"])
+        elif method == "STATS_REQ":
+            s = self.engine.stats()
+            wire.reply_msg(
+                conn,
+                {
+                    "method": "STATS_RES",
+                    "address": self.addr_s,
+                    "validations": s["validations"],
+                    "solved": s["solved"],
+                },
+            )
+        else:
+            print(f"[{self.addr_s}] unknown method {method!r}")
+
+    # -- membership ----------------------------------------------------------
+    def _broadcast_network(self) -> None:
+        with self._lock:
+            members = list(self.network)
+            payload = {
+                "method": "UPDATE_NETWORK",
+                "network": members,
+                "coordinator": self.coordinator,
+            }
+        for m in members:
+            if m != self.addr_s:
+                try:
+                    wire.send_msg(wire.parse_addr(m), payload, self.config.io_timeout_s)
+                except WireError:
+                    pass  # its detector will notice soon enough
+
+    def _on_join_req(self, joiner: str) -> None:
+        if self.coordinator != self.addr_s:
+            wire.send_msg(
+                wire.parse_addr(self.coordinator),
+                {"method": "JOIN_REQ", "addr": joiner},
+                self.config.io_timeout_s,
+            )
+            return
+        with self._lock:
+            if joiner not in self.network:
+                self.network.append(joiner)
+            self._last_hb = time.monotonic()
+        self._broadcast_network()
+
+    def _on_update_network(self, network: list[str], coordinator: str) -> None:
+        with self._lock:
+            self.network = network
+            self.coordinator = coordinator
+            self._last_hb = time.monotonic()
+            gone = [
+                u for u, e in self._ledger.items() if e["member"] not in network
+            ]
+        for u in gone:
+            self._reexecute(u)
+
+    def _on_node_failed(self, dead: str) -> None:
+        if self.coordinator == self.addr_s:
+            with self._lock:
+                if dead in self.network:
+                    self.network.remove(dead)
+                self._last_hb = time.monotonic()
+            self._broadcast_network()
+            self._on_update_network(list(self.network), self.coordinator)
+        else:
+            try:
+                wire.send_msg(
+                    wire.parse_addr(self.coordinator),
+                    {"method": "NODE_FAILED", "addr": dead},
+                    self.config.io_timeout_s,
+                )
+            except WireError:
+                pass
+
+    def _on_peer_dead(self, dead: str) -> None:
+        """My predecessor went silent (``check_neighbor`` analog, :158-209)."""
+        with self._lock:
+            if dead not in self.network:
+                return
+            if dead == self.coordinator:
+                # I am the unique detector of the coordinator: self-promote
+                # (``DHT_Node.py:191-193``).
+                self.coordinator = self.addr_s
+            self._last_hb = time.monotonic()
+        self._on_node_failed(dead)
+
+    # -- job dispatch --------------------------------------------------------
+    def submit(self, grid) -> Job:
+        g = np.asarray(grid, dtype=np.int32)
+        if g.ndim != 2 or g.shape[0] != g.shape[1]:
+            raise ValueError(f"grid must be square, got {g.shape}")
+        member = self._pick_member()
+        if member == self.addr_s:
+            return self._submit_local(g)
+        return self._submit_remote(g, member)
+
+    def cancel(self, job_uuid: str) -> None:
+        self.engine.cancel(job_uuid)
+        with self._lock:
+            entry = self._ledger.get(job_uuid)
+        if entry is not None:
+            try:
+                wire.send_msg(
+                    wire.parse_addr(entry["member"]),
+                    {"method": "CANCEL", "uuid": job_uuid},
+                    self.config.io_timeout_s,
+                )
+            except WireError:
+                pass
+
+    def _pick_member(self) -> str:
+        """Least-outstanding member; ties broken round-robin (load balance)."""
+        with self._lock:
+            members = list(self.network)
+            if len(members) == 1:
+                return self.addr_s
+            self._rr += 1
+            counts = [
+                (self._outstanding.get(m, 0), (i + self._rr) % len(members), m)
+                for i, m in enumerate(members)
+            ]
+        return min(counts)[2]
+
+    def _track(self, member: str, delta: int) -> None:
+        with self._lock:
+            self._outstanding[member] = self._outstanding.get(member, 0) + delta
+
+    def _submit_local(self, g: np.ndarray) -> Job:
+        job = self.engine.submit(g)
+        self._track(self.addr_s, +1)
+        threading.Thread(
+            target=lambda: (job.done.wait(), self._track(self.addr_s, -1)),
+            daemon=True,
+        ).start()
+        return job
+
+    def _submit_remote(self, g: np.ndarray, member: str) -> Job:
+        geom = geometry_for_size(g.shape[0])
+        job = Job(uuid=f"{self.addr_s}/{time.monotonic_ns()}", grid=g, geom=geom)
+        with self._lock:
+            self._ledger[job.uuid] = {"grid": g, "member": member, "job": job}
+        self._track(member, +1)
+        try:
+            wire.send_msg(
+                wire.parse_addr(member),
+                {
+                    "method": "TASK",
+                    "uuid": job.uuid,
+                    "grid": g.tolist(),
+                    "origin": self.addr_s,
+                },
+                self.config.io_timeout_s,
+            )
+        except WireError:
+            # Reliable transport tells us delivery failed -> immediate local
+            # re-execution instead of the reference's silent loss (§2.5 #7).
+            self._reexecute(job.uuid)
+        return job
+
+    def _reexecute(self, job_uuid: str) -> None:
+        with self._lock:
+            entry = self._ledger.pop(job_uuid, None)
+        if entry is None:
+            return
+        self._track(entry["member"], -1)
+        handle: Job = entry["job"]
+        local = self.engine.submit(entry["grid"], job_uuid=job_uuid)
+        self._track(self.addr_s, +1)
+
+        def relay():
+            local.done.wait()
+            self._track(self.addr_s, -1)
+            handle.solution = local.solution
+            handle.solved = local.solved
+            handle.unsat = local.unsat
+            handle.nodes = local.nodes
+            handle.cancelled = local.cancelled
+            handle.done.set()
+
+        threading.Thread(target=relay, daemon=True).start()
+
+    def _on_task(self, msg: dict) -> None:
+        grid = np.asarray(msg["grid"], dtype=np.int32)
+        origin = msg["origin"]
+        job = self.engine.submit(grid, job_uuid=msg["uuid"])
+
+        def reply():
+            job.done.wait()
+            payload = {
+                "method": "SOLUTION",
+                "uuid": job.uuid,
+                "solved": job.solved,
+                "unsat": job.unsat,
+                "nodes": job.nodes,
+                "solution": job.solution.tolist() if job.solution is not None else None,
+            }
+            try:
+                wire.send_msg(
+                    wire.parse_addr(origin), payload, self.config.io_timeout_s
+                )
+            except WireError:
+                pass  # origin died; its successor's repair already re-executed
+
+        threading.Thread(target=reply, daemon=True).start()
+
+    def _on_solution(self, msg: dict) -> None:
+        with self._lock:
+            entry = self._ledger.pop(msg["uuid"], None)
+        if entry is None:
+            return  # already re-executed or cancelled
+        self._track(entry["member"], -1)
+        handle: Job = entry["job"]
+        handle.solved = bool(msg["solved"])
+        handle.unsat = bool(msg["unsat"])
+        handle.nodes = int(msg["nodes"])
+        if msg["solution"] is not None:
+            handle.solution = np.asarray(msg["solution"], dtype=np.int32)
+        handle.done.set()
+
+    # -- views (HTTP layer) --------------------------------------------------
+    def stats_view(self) -> dict:
+        """Reference `/stats` shape (``DHT_Node.py:573-586``), sleep-free."""
+        s = self.engine.stats()
+        nodes = [{"address": self.addr_s, "validations": s["validations"]}]
+        total_v, total_s = s["validations"], s["solved"]
+        with self._lock:
+            peers = [m for m in self.network if m != self.addr_s]
+        for m in peers:
+            try:
+                res = wire.request(
+                    wire.parse_addr(m),
+                    {"method": "STATS_REQ"},
+                    self.config.stats_timeout_s,
+                )
+                nodes.append(
+                    {"address": res["address"], "validations": res["validations"]}
+                )
+                total_v += res["validations"]
+                total_s += res["solved"]
+            except WireError:
+                nodes.append({"address": m, "validations": None})
+        return {"all": {"solved": total_s, "validations": total_v}, "nodes": nodes}
+
+    def network_view(self) -> dict:
+        """Reference `/network` shape (``DHT_Node.py:600-614``)."""
+        with self._lock:
+            members = list(self.network)
+        return {
+            m: [
+                members[(i - 1) % len(members)],
+                members[(i + 1) % len(members)],
+            ]
+            for i, m in enumerate(members)
+        }

@@ -1,0 +1,93 @@
+"""Typed, length-framed JSON messages over TCP — the control-plane transport.
+
+Replaces the reference's wire layer (``/root/reference/DHT_Node.py:74-99``)
+and removes its three structural flaws by construction (SURVEY.md §2.3):
+
+* **pickle → JSON**: no RCE surface from network input (`pickle.loads` at
+  ``:83,99``);
+* **UDP → TCP**: no silently-lost TASK messages (§2.5 #7) — delivery either
+  succeeds or raises at the sender, which can then re-dispatch;
+* **1024-byte recv cap → 4-byte length prefix**: 25x25 boards (1.5 KB
+  pickled, truncated by the reference — §2.5 #8) frame like anything else.
+
+Connection discipline is datagram-style on purpose: one connection per
+message (optionally one reply on the same connection), so there is no
+session state to repair after a peer dies — matching the reference's
+fire-and-forget model with reliability added.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+Addr = Tuple[str, int]
+
+MAX_FRAME = 16 * 1024 * 1024  # generous: a 25x25 grid message is ~2 KB
+_LEN = struct.Struct(">I")
+
+
+class WireError(Exception):
+    """Transport-level failure: peer unreachable, bad frame, oversize."""
+
+
+def addr_str(addr: Addr) -> str:
+    return f"{addr[0]}:{addr[1]}"
+
+
+def parse_addr(s: str) -> Addr:
+    host, _, port = s.rpartition(":")
+    return host, int(port)
+
+
+def _send_frame(sock: socket.socket, msg: dict) -> None:
+    data = json.dumps(msg).encode()
+    if len(data) > MAX_FRAME:
+        raise WireError(f"frame too large: {len(data)} bytes")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> dict:
+    (length,) = _LEN.unpack(_recv_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise WireError(f"frame too large: {length} bytes")
+    msg = json.loads(_recv_exact(sock, length))
+    if not isinstance(msg, dict) or "method" not in msg:
+        raise WireError("malformed message: expected dict with 'method'")
+    return msg
+
+
+def reply_msg(sock: socket.socket, msg: dict) -> None:
+    _send_frame(sock, msg)
+
+
+def send_msg(addr: Addr, msg: dict, timeout: float = 5.0) -> None:
+    """Fire-and-forget (but reliable): deliver one message, no reply."""
+    try:
+        with socket.create_connection(addr, timeout=timeout) as sock:
+            _send_frame(sock, msg)
+    except OSError as e:
+        raise WireError(f"send to {addr_str(addr)} failed: {e}") from e
+
+
+def request(addr: Addr, msg: dict, timeout: float = 5.0) -> dict:
+    """Send one message and wait for one reply frame on the same connection."""
+    try:
+        with socket.create_connection(addr, timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            _send_frame(sock, msg)
+            return recv_msg(sock)
+    except OSError as e:
+        raise WireError(f"request to {addr_str(addr)} failed: {e}") from e

@@ -1,0 +1,150 @@
+"""ctypes bindings for the native CPU oracle (``src/solver.cc``).
+
+The shared library is built on demand with g++ (no pybind11 in the image —
+plain C ABI + ctypes, per the environment constraints).  If no compiler is
+available the callers fall back to the pure-Python oracle in
+``utils/oracle.py``; everything here is an accelerator, not a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "solver.cc")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_libcsp.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-shared",
+        "-fPIC",
+        "-o",
+        _LIB_PATH,
+        _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound library, building it if needed; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        stale = not os.path.exists(_LIB_PATH) or os.path.getmtime(
+            _LIB_PATH
+        ) < os.path.getmtime(_SRC)
+        if stale and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.csp_solve.argtypes = [i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int, i64p]
+        lib.csp_solve.restype = ctypes.c_int
+        lib.csp_count_solutions.argtypes = [
+            i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, i64p,
+        ]
+        lib.csp_count_solutions.restype = ctypes.c_int
+        lib.csp_is_valid_solution.argtypes = [
+            i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.csp_is_valid_solution.restype = ctypes.c_int
+        lib.csp_solve_batch.argtypes = [
+            i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.csp_solve_batch.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def solve(grid, geom: Optional[Geometry] = None) -> Tuple[Optional[np.ndarray], int]:
+    """(lexicographically-least solution | None, nodes expanded)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native solver unavailable (no compiler?)")
+    g = np.ascontiguousarray(np.asarray(grid), dtype=np.int32).copy()
+    n = g.shape[0]
+    geom = geom or geometry_for_size(n)
+    nodes = ctypes.c_int64(0)
+    rc = lib.csp_solve(g.reshape(-1), n, geom.box_h, geom.box_w, ctypes.byref(nodes))
+    if rc < 0:
+        raise ValueError("malformed grid")
+    return (g if rc == 1 else None), int(nodes.value)
+
+
+def count_solutions(grid, geom: Optional[Geometry] = None, limit: int = 2) -> int:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native solver unavailable (no compiler?)")
+    g = np.ascontiguousarray(np.asarray(grid), dtype=np.int32)
+    n = g.shape[0]
+    geom = geom or geometry_for_size(n)
+    rc = lib.csp_count_solutions(
+        g.reshape(-1), n, geom.box_h, geom.box_w, limit, None, None
+    )
+    if rc < 0:
+        raise ValueError("malformed grid")
+    return rc
+
+
+def is_valid_solution(grid, geom: Optional[Geometry] = None) -> bool:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native solver unavailable (no compiler?)")
+    g = np.ascontiguousarray(np.asarray(grid), dtype=np.int32)
+    n = g.shape[0]
+    geom = geom or geometry_for_size(n)
+    return bool(lib.csp_is_valid_solution(g.reshape(-1), n, geom.box_h, geom.box_w))
+
+
+def solve_batch(grids, geom: Optional[Geometry] = None):
+    """Solve count boards in place; returns (solutions, results, nodes)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native solver unavailable (no compiler?)")
+    g = np.ascontiguousarray(np.asarray(grids), dtype=np.int32).copy()
+    count, n = g.shape[0], g.shape[1]
+    geom = geom or geometry_for_size(n)
+    results = np.zeros(count, dtype=np.int32)
+    nodes = np.zeros(count, dtype=np.int64)
+    lib.csp_solve_batch(
+        g.reshape(-1),
+        count,
+        n,
+        geom.box_h,
+        geom.box_w,
+        results.ctypes.data_as(ctypes.c_void_p),
+        nodes.ctypes.data_as(ctypes.c_void_p),
+    )
+    return g, results, nodes

@@ -55,6 +55,7 @@ class SolverConfig:
     max_sweeps: int = 64  # propagation sweeps per fixpoint
     branch: str = "minrem"  # 'minrem' (fastest) | 'first' (reference order)
     steal: bool = True  # receiver-initiated work stealing between lanes
+    ring_steal_k: int = 8  # max boards shipped per step per chip pair (sharded)
 
     def resolve_lanes(self, n_jobs: int) -> int:
         lanes = self.lanes if self.lanes > 0 else max(n_jobs, self.min_lanes)
@@ -80,17 +81,24 @@ class Frontier(NamedTuple):
 
 
 def init_frontier(cand0: jax.Array, config: SolverConfig) -> Frontier:
-    """Seed lane j with job j's root board (the root TASK self-send,
-    ``/root/reference/DHT_Node.py:551``); extra lanes start as thieves."""
+    """Seed each job's root board into its own lane (the root TASK self-send,
+    ``/root/reference/DHT_Node.py:551``); extra lanes start as thieves.
+
+    Seed lanes are *strided* across the lane axis — floor(j*L/J), strictly
+    increasing since L >= J — so that when lanes are sharded over a mesh
+    every chip starts with its share of root jobs instead of chip 0 holding
+    everything.
+    """
     n_jobs, n, _ = cand0.shape
     n_lanes = config.resolve_lanes(n_jobs)
     s = config.stack_slots
+    seed_lane = (jnp.arange(n_jobs, dtype=jnp.int32) * n_lanes) // n_jobs
     stack = jnp.zeros((n_lanes, s, n, n), jnp.uint32)
-    stack = stack.at[:n_jobs, 0].set(cand0.astype(jnp.uint32))
-    sp = jnp.where(jnp.arange(n_lanes) < n_jobs, 1, 0).astype(jnp.int32)
-    job = jnp.where(
-        jnp.arange(n_lanes) < n_jobs, jnp.arange(n_lanes), -1
-    ).astype(jnp.int32)
+    stack = stack.at[seed_lane, 0].set(cand0.astype(jnp.uint32))
+    sp = jnp.zeros(n_lanes, jnp.int32).at[seed_lane].set(1)
+    job = jnp.full(n_lanes, -1, jnp.int32).at[seed_lane].set(
+        jnp.arange(n_jobs, dtype=jnp.int32)
+    )
     return Frontier(
         stack=stack,
         sp=sp,
@@ -261,10 +269,22 @@ def frontier_live(state: Frontier) -> jax.Array:
     return (state.sp > 0) & (state.job >= 0) & ~state.solved[job_safe]
 
 
-def run_frontier(state: Frontier, geom: Geometry, config: SolverConfig) -> Frontier:
-    """Drive steps until every job resolves (solved or search space exhausted)."""
+def run_frontier(
+    state: Frontier,
+    geom: Geometry,
+    config: SolverConfig,
+    step_limit: jax.Array | None = None,
+) -> Frontier:
+    """Drive steps until every job resolves (solved or search space exhausted).
+
+    ``step_limit`` is a *dynamic* cap (defaults to ``config.max_steps``): the
+    checkpointing driver advances the same compiled program in bounded chunks
+    by passing successive limits, without a recompile per chunk.
+    """
+    limit = jnp.int32(config.max_steps) if step_limit is None else step_limit
+    limit = jnp.minimum(limit, jnp.int32(config.max_steps))
 
     def cond(st: Frontier):
-        return jnp.any(frontier_live(st)) & (st.steps < config.max_steps)
+        return jnp.any(frontier_live(st)) & (st.steps < limit)
 
     return jax.lax.while_loop(cond, lambda s: frontier_step(s, geom, config), state)

@@ -1,0 +1,10 @@
+"""Multi-chip parallelism: device meshes, sharded frontier solve, collectives."""
+
+from distributed_sudoku_solver_tpu.parallel.mesh import (  # noqa: F401
+    LANE_AXIS,
+    default_mesh,
+    make_mesh,
+)
+from distributed_sudoku_solver_tpu.parallel.sharded import (  # noqa: F401
+    solve_batch_sharded,
+)

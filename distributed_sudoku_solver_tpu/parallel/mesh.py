@@ -1,0 +1,33 @@
+"""Device mesh construction — the TPU-native "ring membership".
+
+The reference's overlay is a coordinator-maintained ring of UDP processes
+(``/root/reference/DHT_Node.py:260-330``).  On TPU the set of workers is the
+device mesh: membership is static per job, the "ring" is the ICI torus, and
+joining/leaving happens at the job boundary (elasticity is handled by the
+host-level cluster runtime, not by the data plane).  One mesh axis shards the
+frontier's *lane* dimension; collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+# Name of the mesh axis the frontier lane dimension is sharded over.
+LANE_AXIS = "lanes"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, axis_name: str = LANE_AXIS
+) -> Mesh:
+    """A 1-D mesh over ``devices`` (default: every visible device)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def default_mesh() -> Mesh:
+    return make_mesh()

@@ -1,0 +1,257 @@
+"""Multi-chip frontier solve: `shard_map` over a device mesh.
+
+This is the reference's whole distributed layer (SURVEY.md §1 L2+L3)
+re-expressed as compiled collectives:
+
+* **chip = ring node.**  The frontier's lane axis is sharded over the mesh;
+  each chip owns ``L/D`` lanes and steps them in lockstep inside one
+  ``lax.while_loop`` — there is no coordinator process, no UDP, no pickle.
+* **SOLUTION_FOUND broadcast = per-step psum.**  The reference unicasts the
+  solution to every member and waits 2 s (``/root/reference/
+  DHT_Node.py:459-467``); here newly-solved flags are OR-merged across chips
+  with a ``psum`` every step, so cross-chip cancellation latency is one step,
+  not seconds.  The solution row is taken from the lowest-indexed chip that
+  solved (deterministic winner, like the reference's lowest-lane harvest).
+* **NEEDWORK/TASK = receiver-initiated ring ppermute.**  Each step, every
+  chip tells its ring *predecessor* how many idle lanes it has (a scalar
+  ``ppermute`` — literally the reference's NEEDWORK-to-predecessor,
+  ``/root/reference/DHT_Node.py:246-248``); the predecessor pops up to that
+  many *bottom* stack rows (largest subtrees) from its richest lanes and
+  ships them forward (a payload ``ppermute``).  The donor removes exactly
+  what it ships and the receiver has capacity for all of it by construction,
+  so no work is ever dropped — unlike the reference, where a lost UDP TASK
+  silently loses the subtree (SURVEY.md §2.5 #7).
+* **STATS_REQ/RES = psum at finalize.**  Per-chip counters are summed with a
+  collective instead of a 1 s gather sleep (``/root/reference/
+  DHT_Node.py:566-598``).
+
+Everything compiles to one XLA program per (J, geometry, config, mesh);
+collectives ride ICI on real hardware and the same code runs unchanged on a
+``--xla_force_host_platform_device_count`` CPU mesh in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    frontier_live,
+    frontier_step,
+    init_frontier,
+)
+from distributed_sudoku_solver_tpu.ops.solve import SolveResult, _finalize
+from distributed_sudoku_solver_tpu.parallel.mesh import LANE_AXIS, default_mesh
+
+
+def _ring_steal(
+    stack: jax.Array,
+    sp: jax.Array,
+    job: jax.Array,
+    job_live: jax.Array,
+    axis: str,
+    k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Ship up to ``k`` bottom rows from this chip to its ring successor.
+
+    Receiver-initiated and work-conserving: the successor first advertises its
+    idle-lane count, the donor ships ``min(request, donors, k)`` rows and
+    deletes exactly those, and the receiver installs every row it gets (its
+    idle count cannot have shrunk in between — nothing else touches it).
+    """
+    n_dev = jax.lax.axis_size(axis)
+    n_lanes, s, n, _ = stack.shape
+    k = min(k, n_lanes)
+    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
+    slot_k = jnp.arange(k, dtype=jnp.int32)
+
+    fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]  # donor -> successor
+    back = [(i, (i - 1) % n_dev) for i in range(n_dev)]  # request travels back
+
+    idle = sp == 0
+    n_idle = jnp.sum(idle).astype(jnp.int32)
+    request = jax.lax.ppermute(n_idle, axis, back)  # my successor's idle count
+
+    donor = (sp >= 2) & job_live
+    donor_order = jnp.argsort(jnp.where(donor, -sp, jnp.int32(1)), stable=True)
+    n_send = jnp.minimum(jnp.minimum(request, jnp.sum(donor)), k).astype(jnp.int32)
+    take = slot_k < n_send
+    donor_lane = jnp.where(take, donor_order[:k], n_lanes)
+    safe_donor = jnp.clip(donor_lane, 0, n_lanes - 1)
+    boards = jnp.where(take[:, None, None], stack[safe_donor, 0], 0)
+    jobs = jnp.where(take, job[safe_donor], -1)
+
+    # Remove shipped bottoms: donors shift their stacks down one slot.
+    donor_sel = jnp.zeros(n_lanes, bool).at[donor_lane].set(take, mode="drop")
+    shifted = jnp.concatenate([stack[:, 1:], stack[:, -1:]], axis=1)
+    stack = jnp.where(donor_sel[:, None, None, None], shifted, stack)
+    sp = jnp.where(donor_sel, sp - 1, sp)
+
+    boards_in = jax.lax.ppermute(boards, axis, fwd)
+    jobs_in = jax.lax.ppermute(jobs, axis, fwd)
+    n_in = jax.lax.ppermute(n_send, axis, fwd)
+
+    install = slot_k < n_in
+    thief_order = jnp.argsort(jnp.where(idle, lane_idx, n_lanes + lane_idx))
+    thief_lane = jnp.where(install, thief_order[:k], n_lanes)
+    stack = stack.at[thief_lane, 0].set(boards_in, mode="drop")
+    sp = sp.at[thief_lane].set(jnp.where(install, 1, 0), mode="drop")
+    job = job.at[thief_lane].set(jobs_in, mode="drop")
+    return stack, sp, job, n_in
+
+
+def _sharded_step(
+    state: Frontier, geom: Geometry, config: SolverConfig, axis: str
+) -> Frontier:
+    """One lockstep round on every chip: local step, then cross-chip merges."""
+    n_jobs = state.solved.shape[0]
+    n_dev = jax.lax.axis_size(axis)
+    prev_solved = state.solved
+    prev_solution = state.solution
+
+    st = frontier_step(state, geom, config)
+
+    # --- merge job resolution across chips (the SOLUTION_FOUND broadcast) ---
+    newly = st.solved & ~prev_solved
+    newly_any = jax.lax.psum(newly.astype(jnp.int32), axis) > 0
+    dev = jax.lax.axis_index(axis).astype(jnp.int32)
+    key = jnp.where(newly, dev, jnp.int32(n_dev))
+    winner = jax.lax.pmin(key, axis)
+    contrib = jnp.where(
+        (newly & (key == winner))[:, None, None], st.solution, jnp.uint32(0)
+    )
+    solution = jnp.where(
+        newly_any[:, None, None], jax.lax.psum(contrib, axis), prev_solution
+    )
+    solved = prev_solved | newly_any
+    overflowed = jax.lax.psum(st.overflowed.astype(jnp.int32), axis) > 0
+
+    # --- cross-chip work rebalance (NEEDWORK over the ICI ring) -------------
+    stack, sp, job = st.stack, st.sp, st.job
+    steals = st.steals
+    if n_dev > 1 and config.steal and config.ring_steal_k > 0:
+        job_safe = jnp.clip(job, 0, n_jobs - 1)
+        job_live = (job >= 0) & ~solved[job_safe]
+        sp = jnp.where(job_live, sp, 0)
+        stack, sp, job, shipped = _ring_steal(
+            stack, sp, job, job_live, axis, config.ring_steal_k
+        )
+        steals = steals + shipped
+
+    return Frontier(
+        stack=stack,
+        sp=sp,
+        job=job,
+        solved=solved,
+        solution=solution,
+        overflowed=overflowed,
+        nodes=st.nodes,
+        steps=st.steps,
+        sweeps=st.sweeps,
+        expansions=st.expansions,
+        steals=steals,
+    )
+
+
+def _run_sharded(
+    state: Frontier, geom: Geometry, config: SolverConfig, axis: str
+) -> SolveResult:
+    """Per-chip body: the whole solve loop plus the finalize collectives."""
+
+    def cond(st: Frontier):
+        local_live = jnp.any(frontier_live(st)).astype(jnp.int32)
+        return (jax.lax.psum(local_live, axis) > 0) & (st.steps < config.max_steps)
+
+    state = jax.lax.while_loop(
+        cond, lambda st: _sharded_step(st, geom, config, axis), state
+    )
+
+    # Per-chip counters -> global (the STATS aggregation, as one psum).
+    res = _finalize(state)
+    live_local = frontier_live(state)
+    n_jobs = state.solved.shape[0]
+    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
+    has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(live_local, mode="drop")
+    has_work = jax.lax.psum(has_work.astype(jnp.int32), axis) > 0
+    unsat = ~state.solved & ~has_work & ~state.overflowed
+    return SolveResult(
+        solution=res.solution,
+        solved=res.solved,
+        unsat=unsat,
+        overflowed=res.overflowed,
+        nodes=jax.lax.psum(res.nodes, axis),
+        steps=res.steps,
+        sweeps=jax.lax.psum(res.sweeps, axis),
+        expansions=jax.lax.psum(res.expansions, axis),
+        steals=jax.lax.psum(res.steals, axis),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config", "mesh"))
+def _solve_sharded_jit(
+    grids: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
+) -> SolveResult:
+    n_jobs = grids.shape[0]
+    (axis,) = mesh.axis_names
+    n_dev = mesh.devices.size
+
+    # Round the lane count up to a multiple of the mesh size so the lane axis
+    # shards evenly; per-job state is replicated, lane state is sharded.
+    lanes = config.resolve_lanes(n_jobs)
+    lanes = -(-lanes // n_dev) * n_dev
+    cfg = dataclasses.replace(config, lanes=lanes)
+
+    cand0 = encode_grid(grids, geom)
+    state = init_frontier(cand0, cfg)
+
+    lane_specs = Frontier(
+        stack=P(axis),
+        sp=P(axis),
+        job=P(axis),
+        solved=P(),
+        solution=P(),
+        overflowed=P(),
+        nodes=P(),
+        steps=P(),
+        sweeps=P(),
+        expansions=P(),
+        steals=P(),
+    )
+    out_specs = SolveResult(
+        solution=P(),
+        solved=P(),
+        unsat=P(),
+        overflowed=P(),
+        nodes=P(),
+        steps=P(),
+        sweeps=P(),
+        expansions=P(),
+        steals=P(),
+    )
+    body = jax.shard_map(
+        functools.partial(_run_sharded, geom=geom, config=cfg, axis=axis),
+        mesh=mesh,
+        in_specs=(lane_specs,),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return body(state)
+
+
+def solve_batch_sharded(
+    grids,
+    geom: Geometry,
+    config: SolverConfig = SolverConfig(),
+    mesh: Mesh | None = None,
+) -> SolveResult:
+    """Solve int grids [J, n, n] with lanes sharded over every chip in ``mesh``."""
+    mesh = mesh if mesh is not None else default_mesh()
+    return _solve_sharded_jit(jnp.asarray(grids), geom, config, mesh)

@@ -1,0 +1,6 @@
+"""Serving: host-side job engine + reference-compatible HTTP API."""
+
+from distributed_sudoku_solver_tpu.serving.engine import (  # noqa: F401
+    Job,
+    SolverEngine,
+)

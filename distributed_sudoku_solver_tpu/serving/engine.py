@@ -1,0 +1,214 @@
+"""Host-side job engine: an async queue feeding batched device solves.
+
+Replaces the reference's per-node `task_queue` + busy-poll `/solve` plumbing
+(``/root/reference/DHT_Node.py:35,225-250,553-554``) with a single-owner
+device loop (SURVEY.md §5.2: device state has exactly one driving thread, so
+there is none of the reference's unlocked cross-thread mutation):
+
+* **submit** enqueues a uuid-tagged job and returns immediately; callers wait
+  on the job's event (no 10 ms busy-poll — a real `threading.Event`).
+* **the device loop** drains the queue, groups jobs by geometry, pads each
+  group to a bucketed batch size (bounding jit cache growth), and runs the
+  compiled frontier solve; results resolve each job's event.
+* **cancel** is the SOLUTION_FOUND purge at host level: a cancelled uuid is
+  dropped from the queue, or its result discarded if already in flight
+  (in-graph cancellation between concurrent jobs lives in the frontier
+  itself, ``ops/frontier.py``).
+* **stats** mirrors the reference's counters: ``validations`` = branch nodes
+  expanded (``/root/reference/DHT_Node.py:512-513`` analog), ``solved_count``
+  (``:37,428``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import uuid as uuid_mod
+from typing import Optional
+
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+
+@dataclasses.dataclass
+class Job:
+    """One `/solve` request travelling through the engine."""
+
+    uuid: str
+    grid: np.ndarray
+    geom: Geometry
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    solution: Optional[np.ndarray] = None
+    solved: bool = False
+    unsat: bool = False
+    nodes: int = 0
+    cancelled: bool = False
+    error: Optional[str] = None
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    """Smallest power-of-two >= n (capped): one jit entry per bucket, not per J."""
+    b = 1
+    while b < n and b < max_batch:
+        b *= 2
+    return b
+
+
+class SolverEngine:
+    """Single-owner device loop consuming a thread-safe job queue."""
+
+    def __init__(
+        self,
+        config: SolverConfig = SolverConfig(),
+        max_batch: int = 256,
+        batch_window_s: float = 0.002,
+        solve_fn=None,
+    ):
+        self.config = config
+        self.max_batch = max_batch
+        self.batch_window_s = batch_window_s
+        self._solve_fn = solve_fn or (
+            lambda grids, geom, cfg: solve_batch(grids, geom, cfg)
+        )
+        self._queue: "queue.Queue[Job]" = queue.Queue()
+        # Insertion-ordered so stale entries (cancels for jobs that already
+        # finished or never arrive) can be pruned oldest-first.
+        self._cancelled: "dict[str, None]" = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Counters (single-writer: the device loop; readers tolerate staleness).
+        self.validations = 0
+        self.solved_count = 0
+        self.jobs_done = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "SolverEngine":
+        self._thread = threading.Thread(target=self._run, daemon=True, name="device-loop")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, grid, geom: Optional[Geometry] = None, job_uuid: Optional[str] = None) -> Job:
+        g = np.asarray(grid, dtype=np.int32)
+        geom = geom or geometry_for_size(g.shape[0])
+        if g.shape != (geom.n, geom.n):
+            raise ValueError(f"grid shape {g.shape} does not match geometry {geom}")
+        job = Job(uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom)
+        self._queue.put(job)
+        return job
+
+    def cancel(self, job_uuid: str) -> None:
+        with self._lock:
+            self._cancelled[job_uuid] = None
+            while len(self._cancelled) > 4096:  # stale-cancel bound
+                self._cancelled.pop(next(iter(self._cancelled)))
+
+    def stats(self) -> dict:
+        return {
+            "validations": int(self.validations),
+            "solved": int(self.solved_count),
+            "jobs_done": int(self.jobs_done),
+        }
+
+    # -- device loop ---------------------------------------------------------
+    def _take_batch(self) -> list[Job]:
+        try:
+            first = self._queue.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        jobs = [first]
+        deadline = time.monotonic() + self.batch_window_s
+        while len(jobs) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                jobs.append(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return jobs
+
+    def _consume_cancel(self, job: Job) -> bool:
+        with self._lock:
+            return self._cancelled.pop(job.uuid, "absent") is None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            jobs = self._take_batch()
+            if not jobs:
+                continue
+            live: list[Job] = []
+            for job in jobs:
+                if self._consume_cancel(job):
+                    job.cancelled = True
+                    job.done.set()
+                else:
+                    live.append(job)
+            # Group by geometry: one compiled program per (bucket, geometry).
+            by_geom: dict[Geometry, list[Job]] = {}
+            for job in live:
+                by_geom.setdefault(job.geom, []).append(job)
+            for geom, group in by_geom.items():
+                # The device loop must survive anything a batch throws
+                # (compile error, bad config, OOM): fail the batch's jobs,
+                # keep serving — a dead loop would strand every later job.
+                try:
+                    self._solve_group(geom, group)
+                except Exception as e:  # noqa: BLE001
+                    for job in group:
+                        if not job.done.is_set():
+                            job.error = f"{type(e).__name__}: {e}"
+                            job.done.set()
+                    print(f"[engine] batch failed ({geom}): {e!r}")
+
+    def _solve_group(self, geom: Geometry, group: list[Job]) -> None:
+        # Respect an explicit lane cap: a fixed-lanes config can only take
+        # batches up to that many jobs per compiled call.
+        if self.config.lanes > 0 and len(group) > self.config.lanes:
+            for i in range(0, len(group), self.config.lanes):
+                self._solve_group(geom, group[i : i + self.config.lanes])
+            return
+        n = geom.n
+        bucket = _bucket(len(group), self.max_batch)
+        if self.config.lanes > 0:
+            bucket = min(bucket, self.config.lanes)
+        grids = np.zeros((bucket, n, n), dtype=np.int32)
+        for i, job in enumerate(group):
+            grids[i] = job.grid
+        # Padding rows replicate the first grid: no new compile shapes, and the
+        # duplicate work is masked out of all stats below.
+        grids[len(group) :] = group[0].grid
+
+        res = self._solve_fn(grids, geom, self.config)
+        solved = np.asarray(res.solved)
+        unsat = np.asarray(res.unsat)
+        solutions = np.asarray(res.solution)
+        nodes = np.asarray(res.nodes)
+
+        for i, job in enumerate(group):
+            job.solved = bool(solved[i])
+            job.unsat = bool(unsat[i])
+            job.nodes = int(nodes[i])
+            if job.solved:
+                job.solution = solutions[i]
+            if self._consume_cancel(job):
+                job.cancelled = True
+            job.done.set()
+        self.validations += int(nodes[: len(group)].sum())
+        self.solved_count += int(solved[: len(group)].sum())
+        self.jobs_done += len(group)

@@ -1,0 +1,157 @@
+"""HTTP API: the reference's three endpoints, JSON-shape compatible.
+
+Endpoint contracts copied behaviorally from ``/root/reference/
+DHT_Node.py:540-614`` (SudokuHandler):
+
+* ``POST /solve``  {"sudoku": [[...]]} -> 201 {"solution": [[...]], "duration": s}
+* ``GET /stats``   -> 200 {"all": {"solved": N, "validations": M},
+                           "nodes": [{"address": "h:p", "validations": V}, ...]}
+* ``GET /network`` -> 200 {"<addr>": ["<predecessor>", "<successor>"], ...}
+
+Differences are deliberate upgrades, not behavior drift:
+
+* the reference busy-polls a shared field at 10 ms and can cross-talk between
+  concurrent requests (it nulls ``solution`` globally, ``:542,563``); here
+  each request waits on its own job event.
+* unsat boards: the reference would search forever; we return 422 with a
+  proven-unsat body (the frontier exhausts the space).
+* ``/stats`` aggregation uses the cluster runtime's snapshot instead of a
+  blind 1 s sleep window (``:571``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # Route table kept flat on purpose: three endpoints, like the reference.
+    def do_POST(self):  # noqa: N802 (stdlib casing)
+        if self.path != "/solve":
+            return self._send(404, {"error": "not found"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            grid = payload["sudoku"]
+        except (ValueError, KeyError, TypeError):
+            return self._send(400, {"error": "body must be JSON {'sudoku': [[...]]}"})
+        node = self.server.solver_node
+        import time
+
+        start = time.time()
+        try:
+            job = node.submit(grid)
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        timeout = self.server.solve_timeout_s
+        if not job.wait(timeout):
+            node.cancel(job.uuid)
+            return self._send(504, {"error": "solve timed out", "uuid": job.uuid})
+        duration = time.time() - start
+        if job.solved:
+            return self._send(
+                201, {"solution": job.solution.tolist(), "duration": duration}
+            )
+        if job.unsat:
+            return self._send(
+                422, {"error": "puzzle is unsatisfiable", "duration": duration}
+            )
+        return self._send(
+            500,
+            {"error": job.error or "search budget exhausted", "duration": duration},
+        )
+
+    def do_GET(self):  # noqa: N802
+        node = self.server.solver_node
+        if self.path == "/stats":
+            return self._send(200, node.stats_view())
+        if self.path == "/network":
+            return self._send(200, node.network_view())
+        return self._send(404, {"error": "not found"})
+
+    def _send(self, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):  # quiet by default; engine has counters
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+
+class ApiServer:
+    """ThreadingHTTPServer wrapper bound to a solver node (or bare engine)."""
+
+    def __init__(
+        self,
+        solver_node,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        solve_timeout_s: float = 300.0,
+        verbose: bool = False,
+    ):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.solver_node = solver_node
+        self.httpd.solve_timeout_s = solve_timeout_s
+        self.httpd.verbose = verbose
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="http-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5)
+
+
+class StandaloneNode:
+    """Single-process node: engine + API, no cluster peers (v1 of serving).
+
+    Presents the same surface the cluster node will: submit/cancel,
+    stats_view, network_view.
+    """
+
+    def __init__(self, engine: Optional[SolverEngine] = None, address: str = "local:0"):
+        self.engine = engine or SolverEngine().start()
+        self.address = address
+
+    def submit(self, grid):
+        import numpy as np
+
+        g = np.asarray(grid, dtype=np.int32)
+        if g.ndim != 2 or g.shape[0] != g.shape[1]:
+            raise ValueError(f"grid must be square, got {g.shape}")
+        return self.engine.submit(g)
+
+    def cancel(self, job_uuid: str) -> None:
+        self.engine.cancel(job_uuid)
+
+    def stats_view(self) -> dict:
+        s = self.engine.stats()
+        return {
+            "all": {"solved": s["solved"], "validations": s["validations"]},
+            "nodes": [{"address": self.address, "validations": s["validations"]}],
+        }
+
+    def network_view(self) -> dict:
+        return {self.address: [self.address, self.address]}

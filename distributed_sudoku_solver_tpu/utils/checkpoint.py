@@ -1,0 +1,157 @@
+"""Checkpoint / resume: the frontier tensor *is* the checkpoint.
+
+The reference has no persistence of any kind — a dead node's in-progress
+subtree is recomputed from the delegator's ledger copy (SURVEY.md §5.4,
+``/root/reference/DHT_Node.py:201-209``).  Here the entire search state of
+every in-flight job is one pytree of device arrays (``ops/frontier.Frontier``),
+so checkpointing is: advance the compiled solve in bounded-step chunks,
+snapshot the state to host between chunks, and resume = reload + keep
+stepping.  No recomputation, ever — a restore continues mid-subtree.
+
+Format: a single ``.npz`` (atomic rename on save) holding every Frontier leaf
+plus the static solve signature (geometry + config repr) for mismatch
+detection at load time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import tempfile
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    frontier_live,
+    init_frontier,
+    run_frontier,
+)
+from distributed_sudoku_solver_tpu.ops.solve import SolveResult, _finalize
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config"))
+def start_frontier(grids: jax.Array, geom: Geometry, config: SolverConfig) -> Frontier:
+    return init_frontier(encode_grid(grids, geom), config)
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config"))
+def advance_frontier(
+    state: Frontier, step_limit: jax.Array, geom: Geometry, config: SolverConfig
+) -> Frontier:
+    """Run until every job resolves or ``state.steps`` reaches ``step_limit``."""
+    return run_frontier(state, geom, config, step_limit=step_limit)
+
+
+def frontier_done(state: Frontier) -> bool:
+    return not bool(jnp.any(frontier_live(state)))
+
+
+def _signature(
+    geom: Geometry, config: SolverConfig, grids_hash: Optional[str] = None
+) -> str:
+    return json.dumps(
+        {
+            "geom": [geom.box_h, geom.box_w],
+            "config": dataclasses.asdict(config),
+            "grids": grids_hash,
+        }
+    )
+
+
+def grids_digest(grids) -> str:
+    """Content hash of the job batch: a checkpoint resumes only its own inputs."""
+    import hashlib
+
+    arr = np.ascontiguousarray(np.asarray(grids, dtype=np.int32))
+    return hashlib.sha256(arr.tobytes() + str(arr.shape).encode()).hexdigest()[:16]
+
+
+def save_frontier(
+    path: str,
+    state: Frontier,
+    geom: Geometry,
+    config: SolverConfig,
+    grids_hash: Optional[str] = None,
+) -> None:
+    """Atomic snapshot: device -> host -> tmpfile -> rename."""
+    host = {k: np.asarray(v) for k, v in state._asdict().items()}
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f, __signature__=np.frombuffer(
+                    _signature(geom, config, grids_hash).encode(), dtype=np.uint8
+                ), **host,
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_frontier(
+    path: str,
+    geom: Geometry,
+    config: SolverConfig,
+    grids_hash: Optional[str] = None,
+) -> Frontier:
+    with np.load(path) as data:
+        sig = bytes(data["__signature__"]).decode()
+        want = _signature(geom, config, grids_hash)
+        if sig != want:
+            raise ValueError(
+                f"checkpoint signature mismatch: saved {sig}, requested {want}"
+            )
+        return Frontier(**{k: jnp.asarray(data[k]) for k in Frontier._fields})
+
+
+def solve_batch_checkpointed(
+    grids,
+    geom: Geometry,
+    config: SolverConfig = SolverConfig(),
+    checkpoint_path: Optional[str] = None,
+    chunk_steps: int = 256,
+    resume: bool = True,
+    on_chunk: Optional[Callable[[Frontier], None]] = None,
+) -> SolveResult:
+    """Solve with periodic snapshots (and resume from an existing one).
+
+    If ``checkpoint_path`` exists and ``resume``, the run continues exactly
+    where the file left off — same compiled program, same search order, so
+    the result is bit-identical to an uninterrupted run.  The file is
+    removed on successful completion.
+    """
+    grids = jnp.asarray(grids)
+    ghash = grids_digest(grids)
+    state = None
+    if checkpoint_path and resume and os.path.exists(checkpoint_path):
+        state = load_frontier(checkpoint_path, geom, config, grids_hash=ghash)
+    if state is None:
+        state = start_frontier(grids, geom, config)
+
+    while True:
+        limit = jnp.int32(min(int(state.steps) + chunk_steps, config.max_steps))
+        state = advance_frontier(state, limit, geom, config)
+        jax.block_until_ready(state)
+        if frontier_done(state) or int(state.steps) >= config.max_steps:
+            break
+        if checkpoint_path:
+            save_frontier(checkpoint_path, state, geom, config, grids_hash=ghash)
+        if on_chunk is not None:
+            on_chunk(state)
+
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        os.unlink(checkpoint_path)
+    return jax.jit(_finalize)(state)

@@ -1,0 +1,111 @@
+"""API tests: golden request/response pairs for /solve, /stats, /network
+(SURVEY.md §4 item 5), plus engine-level batching and cancellation."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.http import ApiServer, StandaloneNode
+from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+SMALL = SolverConfig(min_lanes=8, stack_slots=24, max_steps=20_000)
+
+
+@pytest.fixture(scope="module")
+def server():
+    engine = SolverEngine(config=SMALL, max_batch=8).start()
+    node = StandaloneNode(engine=engine, address="127.0.0.1:test")
+    api = ApiServer(node, host="127.0.0.1", port=0, solve_timeout_s=120).start()
+    yield api
+    api.stop()
+    engine.stop()
+
+
+def _request(api, path, body=None):
+    url = f"http://127.0.0.1:{api.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method="POST" if data else "GET")
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_solve_endpoint(server):
+    status, body = _request(server, "/solve", {"sudoku": np.asarray(EASY_9).tolist()})
+    assert status == 201
+    assert set(body) == {"solution", "duration"}
+    sol = np.asarray(body["solution"])
+    assert is_valid_solution(sol)
+    mask = np.asarray(EASY_9) != 0
+    assert np.array_equal(sol[mask], np.asarray(EASY_9)[mask])
+    assert body["duration"] > 0
+
+
+def test_solve_unsat_returns_422(server):
+    bad = np.asarray(EASY_9).copy()
+    bad[0, 0], bad[0, 1] = 5, 5
+    status, body = _request(server, "/solve", {"sudoku": bad.tolist()})
+    assert status == 422
+    assert "unsat" in body["error"]
+
+
+def test_solve_bad_body_returns_400(server):
+    status, _ = _request(server, "/solve", {"wrong_key": []})
+    assert status == 400
+    status, _ = _request(server, "/solve", {"sudoku": [[1, 2], [3, 4], [5, 6]]})
+    assert status == 400
+
+
+def test_stats_shape(server):
+    # Reference JSON shape: /root/reference/DHT_Node.py:573-586.
+    status, body = _request(server, "/stats")
+    assert status == 200
+    assert set(body) == {"all", "nodes"}
+    assert set(body["all"]) == {"solved", "validations"}
+    assert body["all"]["solved"] >= 1  # test_solve_endpoint ran first
+    assert isinstance(body["nodes"], list) and body["nodes"]
+    assert {"address", "validations"} <= set(body["nodes"][0])
+
+
+def test_network_shape(server):
+    status, body = _request(server, "/network")
+    assert status == 200
+    for addr, (pred, succ) in body.items():
+        assert isinstance(pred, str) and isinstance(succ, str)
+
+
+def test_unknown_paths(server):
+    assert _request(server, "/nope")[0] == 404
+
+
+def test_engine_batches_concurrent_jobs():
+    engine = SolverEngine(config=SMALL, max_batch=8, batch_window_s=0.05).start()
+    try:
+        jobs = [engine.submit(EASY_9) for _ in range(5)]
+        for job in jobs:
+            assert job.wait(120)
+            assert job.solved
+            assert is_valid_solution(job.solution)
+        assert engine.solved_count == 5
+    finally:
+        engine.stop()
+
+
+def test_engine_cancel_before_run():
+    engine = SolverEngine(config=SMALL)  # not started: job sits in queue
+    job = engine.submit(EASY_9)
+    engine.cancel(job.uuid)
+    engine.start()
+    try:
+        assert job.wait(60)
+        assert job.cancelled
+        assert not job.solved
+    finally:
+        engine.stop()

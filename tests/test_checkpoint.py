@@ -1,0 +1,80 @@
+"""Checkpoint/resume: interrupted solves continue bit-exactly (SURVEY.md §5.4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+from distributed_sudoku_solver_tpu.utils.checkpoint import (
+    advance_frontier,
+    frontier_done,
+    grids_digest,
+    load_frontier,
+    save_frontier,
+    solve_batch_checkpointed,
+    start_frontier,
+)
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+CFG = SolverConfig(min_lanes=16, stack_slots=48)
+
+
+def test_checkpointed_equals_direct(tmp_path):
+    grids = np.stack([EASY_9, HARD_9[0], HARD_9[1]])
+    ckpt = str(tmp_path / "front.npz")
+    saves = []
+    res = solve_batch_checkpointed(
+        grids, SUDOKU_9, CFG, checkpoint_path=ckpt, chunk_steps=4,
+        on_chunk=lambda st: saves.append(int(st.steps)),
+    )
+    direct = solve_batch(grids, SUDOKU_9, CFG)
+    np.testing.assert_array_equal(np.asarray(res.solution), np.asarray(direct.solution))
+    np.testing.assert_array_equal(np.asarray(res.solved), np.asarray(direct.solved))
+    assert int(res.steps) == int(direct.steps)
+    assert saves, "expected at least one checkpoint chunk"
+    assert not os.path.exists(ckpt), "checkpoint removed after completion"
+
+
+def test_resume_after_simulated_crash(tmp_path):
+    grids = np.stack([HARD_9[0]])
+    ckpt = str(tmp_path / "front.npz")
+
+    # "Crash" after a few chunks: drive manually, save, drop all live state.
+    state = start_frontier(np.asarray(grids), SUDOKU_9, CFG)
+    state = advance_frontier(state, np.int32(6), SUDOKU_9, CFG)
+    assert not frontier_done(state)
+    save_frontier(ckpt, state, SUDOKU_9, CFG, grids_hash=grids_digest(grids))
+    steps_at_crash = int(state.steps)
+    del state
+
+    # Restart: resumes from the file, no recomputation of the first chunk.
+    res = solve_batch_checkpointed(
+        grids, SUDOKU_9, CFG, checkpoint_path=ckpt, chunk_steps=64
+    )
+    direct = solve_batch(grids, SUDOKU_9, CFG)
+    assert int(res.steps) == int(direct.steps) >= steps_at_crash
+    np.testing.assert_array_equal(np.asarray(res.solution), np.asarray(direct.solution))
+
+
+def test_signature_mismatch_rejected(tmp_path):
+    ckpt = str(tmp_path / "front.npz")
+    state = start_frontier(np.stack([EASY_9]), SUDOKU_9, CFG)
+    save_frontier(ckpt, state, SUDOKU_9, CFG)
+    other = SolverConfig(min_lanes=32, stack_slots=48)
+    with pytest.raises(ValueError, match="signature mismatch"):
+        load_frontier(ckpt, SUDOKU_9, other)
+
+
+def test_checkpoint_for_different_grids_rejected(tmp_path):
+    # A stale checkpoint from batch A must not resume for batch B.
+    ckpt = str(tmp_path / "front.npz")
+    grids_a = np.stack([HARD_9[0]])
+    state = start_frontier(grids_a, SUDOKU_9, CFG)
+    state = advance_frontier(state, np.int32(4), SUDOKU_9, CFG)
+    save_frontier(ckpt, state, SUDOKU_9, CFG, grids_hash=grids_digest(grids_a))
+    grids_b = np.stack([HARD_9[1]])
+    with pytest.raises(ValueError, match="signature mismatch"):
+        load_frontier(ckpt, SUDOKU_9, CFG, grids_hash=grids_digest(grids_b))

@@ -1,0 +1,52 @@
+"""16x16 and 25x25 boards: the geometries the reference hard-coding (9/3,
+``/root/reference/utils.py:20-21,48-53``) and 1024-byte wire cap
+(``/root/reference/DHT_Node.py:94``, truncates 25x25 — SURVEY.md §2.5 #8/#9)
+made impossible.  One generic compiled kernel serves them all here."""
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu import native
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_16, SUDOKU_25
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
+
+
+def _check(sol, puzzle, geom):
+    assert is_valid_solution(sol, geom)
+    mask = puzzle != 0
+    assert np.array_equal(sol[mask], puzzle[mask])
+    if native.available():
+        assert native.is_valid_solution(sol, geom)
+
+
+def test_16x16_batch():
+    puzzles = np.stack(
+        [make_puzzle(SUDOKU_16, seed=s, n_clues=150, unique=False) for s in (0, 1)]
+    )
+    cfg = SolverConfig(min_lanes=8, stack_slots=64, max_steps=50_000)
+    res = solve_batch(puzzles, SUDOKU_16, cfg)
+    assert np.all(np.asarray(res.solved)), f"unsolved: {np.asarray(res.solved)}"
+    for j in range(puzzles.shape[0]):
+        _check(np.asarray(res.solution[j]), puzzles[j], SUDOKU_16)
+
+
+def test_25x25_solve():
+    puzzle = make_puzzle(SUDOKU_25, seed=3, n_clues=480, unique=False)
+    cfg = SolverConfig(min_lanes=4, stack_slots=48, max_steps=50_000)
+    res = solve_batch(puzzle[None], SUDOKU_25, cfg)
+    assert bool(res.solved[0])
+    _check(np.asarray(res.solution[0]), puzzle, SUDOKU_25)
+
+
+def test_25x25_unsat_detected():
+    puzzle = make_puzzle(SUDOKU_25, seed=4, n_clues=500, unique=False)
+    r, c = np.argwhere(puzzle == 0)[0]
+    row_digits = set(puzzle[r][puzzle[r] > 0])
+    puzzle[r, c] = next(iter(row_digits))  # duplicate within the row
+    cfg = SolverConfig(min_lanes=4, stack_slots=48)
+    res = solve_batch(puzzle[None], SUDOKU_25, cfg)
+    assert not bool(res.solved[0])
+    assert bool(res.unsat[0])

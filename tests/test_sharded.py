@@ -1,0 +1,94 @@
+"""Multi-chip semantics on the virtual 8-device CPU mesh (SURVEY.md §4 item 3).
+
+The real `shard_map` + collectives run on fake devices — the TPU-world
+replacement for the reference's loopback-multiprocess methodology.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+from distributed_sudoku_solver_tpu.parallel import make_mesh, solve_batch_sharded
+from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution, solve_oracle
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+
+def _respects_clues(solution, puzzle):
+    puzzle = np.asarray(puzzle)
+    solution = np.asarray(solution)
+    return bool(np.all((puzzle == 0) | (solution == puzzle)))
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_matches_single_device():
+    grids = np.stack([EASY_9, *HARD_9])
+    cfg = SolverConfig(min_lanes=64, stack_slots=64)
+    res1 = solve_batch(grids, SUDOKU_9, cfg)
+    res8 = solve_batch_sharded(grids, SUDOKU_9, cfg, mesh=make_mesh())
+    assert np.all(np.asarray(res8.solved))
+    assert not np.any(np.asarray(res8.overflowed))
+    np.testing.assert_array_equal(np.asarray(res8.solved), np.asarray(res1.solved))
+    for j in range(grids.shape[0]):
+        sol = np.asarray(res8.solution[j])
+        assert is_valid_solution(sol)
+        assert _respects_clues(sol, grids[j])
+    # Unique-solution boards: bit-exact with the single-device path + oracle.
+    np.testing.assert_array_equal(
+        np.asarray(res8.solution), np.asarray(res1.solution)
+    )
+
+
+def test_sharded_bit_exact_vs_oracle():
+    grids = np.stack(HARD_9)
+    res = solve_batch_sharded(grids, SUDOKU_9, SolverConfig())
+    for j in range(grids.shape[0]):
+        expect = solve_oracle(grids[j])
+        np.testing.assert_array_equal(np.asarray(res.solution[j]), expect)
+
+
+def test_ring_steal_spreads_one_hard_job():
+    # One job on an 8-chip mesh: only cross-chip stealing can occupy 7 chips.
+    # HARD_9[0] ("AI Escargot") needs ~70 branch nodes even with propagation;
+    # HARD_9[2] would be useless here — it solves by propagation alone.
+    grids = np.asarray(HARD_9[0])[None]
+    cfg = SolverConfig(min_lanes=32, stack_slots=64, ring_steal_k=4)
+    res = solve_batch_sharded(grids, SUDOKU_9, cfg)
+    assert bool(res.solved[0])
+    assert int(res.steals) > 0
+    assert is_valid_solution(np.asarray(res.solution[0]))
+
+
+def test_sharded_unsat_is_proven():
+    # Two identical digits in one row -> contradiction at the root.
+    bad = np.asarray(EASY_9).copy()
+    bad[0, 0] = 5
+    bad[0, 1] = 5
+    grids = bad[None]
+    res = solve_batch_sharded(grids, SUDOKU_9, SolverConfig())
+    assert not bool(res.solved[0])
+    assert bool(res.unsat[0])
+    assert not bool(res.overflowed[0])
+
+
+def test_single_device_submesh():
+    mesh = make_mesh(jax.devices()[:1])
+    grids = np.stack([EASY_9])
+    res = solve_batch_sharded(grids, SUDOKU_9, SolverConfig(), mesh=mesh)
+    assert bool(res.solved[0])
+    assert is_valid_solution(np.asarray(res.solution[0]))
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_submesh_sizes(n_dev):
+    mesh = make_mesh(jax.devices()[:n_dev])
+    grids = np.stack([EASY_9, HARD_9[0]])
+    res = solve_batch_sharded(grids, SUDOKU_9, SolverConfig(), mesh=mesh)
+    assert np.all(np.asarray(res.solved))
+    for j in range(2):
+        assert is_valid_solution(np.asarray(res.solution[j]))
