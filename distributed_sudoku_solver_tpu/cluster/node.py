@@ -93,6 +93,17 @@ class ClusterNode:
         self._lock = threading.RLock()
         self.network: list[str] = [self.addr_s]  # list order defines the ring
         self.coordinator: str = self.addr_s
+        # Monotonic membership version, ordered as (term, epoch): the term
+        # bumps on every coordinator promotion (so a successor's first view
+        # supersedes anything the dead coordinator issued, even epochs the
+        # detector never saw), the epoch bumps on every membership change
+        # within a term.  UPDATE_NETWORK messages arrive on per-connection
+        # threads, so two broadcasts can be *applied* out of order; this
+        # ordering makes installation order-independent (stale views are
+        # dropped), where the reference simply last-writer-wins
+        # (``/root/reference/DHT_Node.py:332-336``).
+        self.net_term: int = 0
+        self.net_epoch: int = 0
         self._last_hb = time.monotonic()
         self._ledger: dict[str, dict] = {}  # uuid -> {grid, member, job}
         self._outstanding: dict[str, int] = {}  # member -> in-flight count
@@ -172,6 +183,15 @@ class ClusterNode:
     def _hb_loop(self) -> None:
         while not self._stop.is_set():
             time.sleep(self.config.heartbeat_s)
+            # Coordinator re-broadcasts the view every beat: a member that
+            # missed an UPDATE_NETWORK (send failure is fire-and-forget)
+            # converges on the next beat instead of never.  Off-thread, so a
+            # partitioned member's connect timeout cannot delay our own
+            # heartbeats past the failure threshold.
+            if self.coordinator == self.addr_s and len(self.network) > 1:
+                threading.Thread(
+                    target=self._broadcast_network, daemon=True
+                ).start()
             pred, succ = self._ring()
             if succ is None:
                 with self._lock:
@@ -197,7 +217,12 @@ class ClusterNode:
         if method == "JOIN_REQ":
             self._on_join_req(msg["addr"])
         elif method == "UPDATE_NETWORK":
-            self._on_update_network(list(msg["network"]), msg["coordinator"])
+            self._on_update_network(
+                list(msg["network"]),
+                msg["coordinator"],
+                int(msg["term"]),
+                int(msg["epoch"]),
+            )
         elif method == "HEARTBEAT":
             with self._lock:
                 self._last_hb = time.monotonic()
@@ -233,6 +258,8 @@ class ClusterNode:
                 "method": "UPDATE_NETWORK",
                 "network": members,
                 "coordinator": self.coordinator,
+                "term": self.net_term,
+                "epoch": self.net_epoch,
             }
         for m in members:
             if m != self.addr_s:
@@ -252,28 +279,55 @@ class ClusterNode:
         with self._lock:
             if joiner not in self.network:
                 self.network.append(joiner)
+                self.net_epoch += 1
             self._last_hb = time.monotonic()
         self._broadcast_network()
 
-    def _on_update_network(self, network: list[str], coordinator: str) -> None:
+    def _on_update_network(
+        self, network: list[str], coordinator: str, term: int, epoch: int
+    ) -> None:
+        rejoin = False
         with self._lock:
+            if (term, epoch) <= (self.net_term, self.net_epoch):
+                return  # stale or duplicate view; ours is at least as new
             self.network = network
             self.coordinator = coordinator
+            self.net_term = term
+            self.net_epoch = epoch
             self._last_hb = time.monotonic()
+            # Evicted by a false death verdict (e.g. my heartbeats starved):
+            # re-join through the coordinator rather than orbiting alone.
+            rejoin = self.addr_s not in network and not self._stop.is_set()
             gone = [
                 u for u, e in self._ledger.items() if e["member"] not in network
             ]
         for u in gone:
             self._reexecute(u)
+        if rejoin:
+            try:
+                wire.send_msg(
+                    wire.parse_addr(coordinator),
+                    {"method": "JOIN_REQ", "addr": self.addr_s},
+                    self.config.io_timeout_s,
+                )
+            except WireError:
+                pass
 
     def _on_node_failed(self, dead: str) -> None:
         if self.coordinator == self.addr_s:
             with self._lock:
                 if dead in self.network:
                     self.network.remove(dead)
+                    self.net_epoch += 1
                 self._last_hb = time.monotonic()
+                gone = [
+                    u
+                    for u, e in self._ledger.items()
+                    if e["member"] not in self.network
+                ]
             self._broadcast_network()
-            self._on_update_network(list(self.network), self.coordinator)
+            for u in gone:
+                self._reexecute(u)
         else:
             try:
                 wire.send_msg(
@@ -291,8 +345,10 @@ class ClusterNode:
                 return
             if dead == self.coordinator:
                 # I am the unique detector of the coordinator: self-promote
-                # (``DHT_Node.py:191-193``).
+                # (``DHT_Node.py:191-193``).  A new term outranks every view
+                # the dead coordinator issued, including epochs we missed.
                 self.coordinator = self.addr_s
+                self.net_term += 1
             self._last_hb = time.monotonic()
         self._on_node_failed(dead)
 
@@ -387,6 +443,7 @@ class ClusterNode:
             handle.unsat = local.unsat
             handle.nodes = local.nodes
             handle.cancelled = local.cancelled
+            handle.error = local.error
             handle.done.set()
 
         threading.Thread(target=relay, daemon=True).start()
@@ -404,6 +461,7 @@ class ClusterNode:
                 "solved": job.solved,
                 "unsat": job.unsat,
                 "nodes": job.nodes,
+                "error": job.error,
                 "solution": job.solution.tolist() if job.solution is not None else None,
             }
             try:
@@ -425,6 +483,7 @@ class ClusterNode:
         handle.solved = bool(msg["solved"])
         handle.unsat = bool(msg["unsat"])
         handle.nodes = int(msg["nodes"])
+        handle.error = msg.get("error")
         if msg["solution"] is not None:
             handle.solution = np.asarray(msg["solution"], dtype=np.int32)
         handle.done.set()

@@ -26,6 +26,11 @@ struct Geom {
   int n, box_h, box_w, n_hboxes;
 };
 
+// All-digits mask; `1u << 32` is UB, so n == 32 (kMaxN) is special-cased.
+inline uint32_t full_mask(int n) {
+  return (n >= 32) ? 0xffffffffu : ((1u << n) - 1u);
+}
+
 inline int box_of(const Geom& g, int r, int c) {
   return (r / g.box_h) * g.n_hboxes + (c / g.box_w);
 }
@@ -60,7 +65,7 @@ struct Searcher {
     }
     const int idx = empties[depth];
     const int r = idx / g.n, c = idx % g.n, b = box_of(g, r, c);
-    uint32_t avail = ~(rows[r] | cols[c] | boxes[b]) & ((1u << g.n) - 1u);
+    uint32_t avail = ~(rows[r] | cols[c] | boxes[b]) & full_mask(g.n);
     while (avail != 0) {
       const uint32_t bit = avail & (~avail + 1u);  // lowest set bit: ascending
       avail &= avail - 1u;
@@ -155,7 +160,7 @@ int csp_is_valid_solution(const int32_t* grid, int n, int box_h, int box_w) {
     return 0;
   }
   Geom g{n, box_h, box_w, n / box_w};
-  const uint32_t full = (n == 32) ? 0xffffffffu : ((1u << n) - 1u);
+  const uint32_t full = full_mask(n);
   uint32_t rows[kMaxN] = {0}, cols[kMaxN] = {0}, boxes[kMaxN] = {0};
   for (int idx = 0; idx < n * n; ++idx) {
     const int v = grid[idx];

@@ -1,0 +1,41 @@
+"""Force JAX onto the in-process CPU backend, evicting device plugins.
+
+Setting ``JAX_PLATFORMS=cpu`` in the environment is not enough when a device
+plugin (e.g. a TPU tunnel) was already *registered* by the interpreter's
+sitecustomize: the captured env is stale, and the first ``jax.devices()``
+would still initialize the tunnel backend (dialing out, and serializing on
+the tunnel).  Used by both ``tests/conftest.py`` (8-virtual-device suite)
+and ``__graft_entry__.dryrun_multichip`` — keep the private-API poking in
+this one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(n_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend; optionally fake ``n_devices`` chips.
+
+    Must run before any JAX *backend* is initialized (importing jax is fine;
+    calling ``jax.devices()`` is not).  Safe to call more than once.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n_devices is not None and "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        # sitecustomize may have imported jax already (capturing the outer
+        # env), so update the live config, not just the env var, and drop
+        # every non-CPU backend factory.
+        jax.config.update("jax_platforms", "cpu")
+        for name in list(_xb._backend_factories):
+            if name != "cpu":
+                _xb._backend_factories.pop(name, None)
+    except Exception:  # pragma: no cover - plugin layout changed; env remains
+        pass

@@ -2,33 +2,19 @@
 
 The TPU-world replacement for the reference's loopback-multiprocess testing
 methodology (SURVEY.md §4): real shard_map collectives on fake devices.
-Must run before jax initializes any backend.
-
-Two layers of defense, because a TPU-tunnel plugin may already be
-*registered* by the interpreter's sitecustomize before pytest imports us:
-setting the env vars alone is not enough — the tunnel backend would still
-be initialized (dialing out, and serializing on the tunnel) at the first
-``jax.devices()``.  Dropping non-CPU backend factories keeps the suite
-hermetic: pure in-process CPU, no device contention with concurrent
-benchmark runs.
+Must run before jax initializes any backend; the heavy lifting (including
+evicting an already-registered TPU-tunnel plugin) lives in
+``utils/cpu_backend.py``.
 """
 
 import os
 
+# Env first, in case importing the package (below) is what first imports jax.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
-try:
-    import jax
-    import jax._src.xla_bridge as _xb
+from distributed_sudoku_solver_tpu.utils.cpu_backend import force_cpu_backend
 
-    # sitecustomize may have imported jax already (capturing JAX_PLATFORMS
-    # from the outer env), so update the live config, not just the env var.
-    jax.config.update("jax_platforms", "cpu")
-    for _name in list(_xb._backend_factories):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-except Exception:  # pragma: no cover - plugin layout changed; env vars remain
-    pass
+force_cpu_backend(n_devices=8)

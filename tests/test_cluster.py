@@ -16,10 +16,12 @@ from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
 from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution, solve_oracle
 from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
 
-# Detection threshold = heartbeat_s * fail_factor = 2 s: fast enough for the
-# kill-tests below, high enough not to false-positive when the suite's XLA
-# compiles peg every core and starve the heartbeat threads.
-FAST = ClusterConfig(heartbeat_s=0.25, fail_factor=8.0, io_timeout_s=2.0)
+# Detection threshold = heartbeat_s * fail_factor = 4 s: fast enough for the
+# kill-tests below (their wait_for budgets are >= 10 s), high enough not to
+# false-positive when the suite's XLA compiles peg every core and starve the
+# heartbeat threads — a false death during ring formation is unrecoverable
+# for the fixture, so this errs well on the side of patience.
+FAST = ClusterConfig(heartbeat_s=0.25, fail_factor=16.0, io_timeout_s=2.0)
 
 
 def oracle_solve_fn(delay: float = 0.0):
@@ -63,7 +65,7 @@ def trio():
     b = make_node(anchor=a.addr)
     c = make_node(anchor=a.addr)
     nodes = [a, b, c]
-    assert wait_for(lambda: all(len(n.network) == 3 for n in nodes))
+    assert wait_for(lambda: all(len(n.network) == 3 for n in nodes), timeout=30)
     yield nodes
     for n in nodes:
         n.kill()
