@@ -1,0 +1,326 @@
+"""Generalized exact cover as a CSProblem: the same engine, a second family.
+
+BASELINE.json config 5 ("Generic exact-cover CSP (N-queens / pentomino)
+reusing the bitmask kernel").  The reference can express exactly one problem
+(9x9 Sudoku, ``/root/reference/utils.py``); this module gives the lane-stack
+engine (``ops/frontier.py``) and the multi-chip path
+(``parallel/sharded.py``) a whole problem *class*:
+
+    choose a subset of ROWS such that every PRIMARY column is covered
+    exactly once and every SECONDARY column at most once
+
+— the dancing-links (DLX) problem, tensorized.  A search state packs two
+bitmask vectors into one ``uint32[1, D]`` tensor:
+
+* ``avail``  (W_r words over R rows): rows not conflicting with the current
+  partial selection.  Chosen rows *stay available* (they conflict with
+  nothing chosen, by construction), which yields the decode invariant: at a
+  solved state ``avail`` is exactly the chosen-row set — any other
+  available row would cover some primary column twice and would have been
+  eliminated when that column's chooser was taken.
+* ``covered`` (W_c words over the primary columns only): columns covered so
+  far.  Secondary columns need no covered-bits — their at-most-once
+  semantics live entirely in the row-conflict matrix.
+
+The three kernels mirror Sudoku's structurally: *propagate* repeatedly
+takes the unique row of any 1-candidate column (naked singles), *status*
+reads "all primary covered" / "some uncovered column has 0 candidates",
+and *branch* splits on an MRV column — take its lowest available row
+vs. exclude that row, a binary partition exactly like the digit split in
+``models/sudoku.py``.
+
+Instance matrices are baked into the compiled program as constants; the
+problem object is jit-static via a content digest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sudoku_solver_tpu.ops.bitmask import lowest_bit, popcount
+
+_BIG = jnp.int32(2**30)
+
+
+def _pack_bits(a: np.ndarray) -> np.ndarray:
+    """bool[..., K] -> uint32[..., ceil(K/32)], bit b of word w = index w*32+b."""
+    a = np.asarray(a, dtype=bool)
+    k = a.shape[-1]
+    w = -(-k // 32) if k else 1
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, w * 32 - k)]
+    a = np.pad(a, pad)
+    a = a.reshape(*a.shape[:-1], w, 32)
+    weights = (np.uint64(1) << np.arange(32, dtype=np.uint64))
+    return (a.astype(np.uint64) * weights).sum(-1).astype(np.uint32)
+
+
+def _unpack_bits(packed: np.ndarray, k: int) -> np.ndarray:
+    """Inverse of :func:`_pack_bits` (host-side, for decoding solutions)."""
+    packed = np.asarray(packed, dtype=np.uint32)
+    bits = (packed[..., :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(*packed.shape[:-1], -1)[..., :k].astype(bool)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ExactCoverCSP:
+    """One generalized-exact-cover instance (jit-static via content digest)."""
+
+    name: str
+    n_rows: int
+    n_primary: int
+    col_rows: np.ndarray  # uint32[C, W_r]: rows covering each primary column
+    row_cols: np.ndarray  # uint32[R, W_c]: primary columns covered by each row
+    elim: np.ndarray  # uint32[R, W_r]: rows conflicting with row r (r excluded)
+    max_sweeps: int = 64
+
+    def __post_init__(self) -> None:
+        h = hashlib.sha256()
+        for arr in (self.col_rows, self.row_cols, self.elim):
+            h.update(np.ascontiguousarray(arr).tobytes())
+        h.update(f"{self.name}:{self.n_rows}:{self.n_primary}:{self.max_sweeps}".encode())
+        object.__setattr__(self, "_digest", h.hexdigest())
+
+    def __hash__(self) -> int:
+        return hash(self._digest)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ExactCoverCSP) and self._digest == other._digest
+
+    # -- geometry ------------------------------------------------------------
+    @property
+    def w_rows(self) -> int:
+        return self.elim.shape[1]
+
+    @property
+    def w_cols(self) -> int:
+        return self.row_cols.shape[1]
+
+    @property
+    def state_shape(self) -> tuple[int, int]:
+        return (1, self.w_rows + self.w_cols)
+
+    def signature(self) -> str:
+        return f"cover:{self.name}:{self._digest[:16]}"
+
+    # -- state packing -------------------------------------------------------
+    def _split(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        flat = states[..., 0, :]
+        return flat[..., : self.w_rows], flat[..., self.w_rows :]
+
+    def _join(self, avail: jax.Array, covered: jax.Array) -> jax.Array:
+        return jnp.concatenate([avail, covered], axis=-1)[..., None, :]
+
+    def initial_state(self) -> np.ndarray:
+        """Root state: every row available, nothing covered — uint32[1, D]."""
+        avail = _pack_bits(np.ones((self.n_rows,), dtype=bool))
+        covered = np.zeros((self.w_cols,), dtype=np.uint32)
+        return np.concatenate([avail, covered])[None, :]
+
+    def state_with_rows_taken(self, rows) -> np.ndarray:
+        """Root state after pre-selecting ``rows`` (host-side; e.g. clues)."""
+        avail = _unpack_bits(self.initial_state()[0, : self.w_rows], self.n_rows)
+        covered = np.zeros((self.n_primary,), dtype=bool)
+        elim = _unpack_bits(self.elim, self.n_rows)
+        cols = _unpack_bits(self.row_cols, self.n_primary)
+        for r in rows:
+            if not avail[r]:
+                raise ValueError(f"row {r} conflicts with an earlier selection")
+            if (covered & cols[r]).any():
+                raise ValueError(f"row {r} re-covers an already-covered column")
+            avail &= ~elim[r]
+            covered |= cols[r]
+        return np.concatenate([_pack_bits(avail), _pack_bits(covered)])[None, :]
+
+    def chosen_rows(self, solution_state) -> np.ndarray:
+        """Solved state -> sorted row indices (the decode invariant above)."""
+        avail = _unpack_bits(
+            np.asarray(solution_state)[..., 0, : self.w_rows], self.n_rows
+        )
+        return np.nonzero(avail)[-1]
+
+    # -- shared pieces -------------------------------------------------------
+    def _counts(self, avail: jax.Array, covered: jax.Array):
+        """cnt[L, C] available rows per primary column; unc[L, C] uncovered."""
+        cr = jnp.asarray(self.col_rows)
+        cnt = popcount(avail[:, None, :] & cr[None]).sum(-1).astype(jnp.int32)
+        c_idx = np.arange(self.n_primary)
+        word = jnp.asarray(c_idx // 32, dtype=jnp.int32)
+        bit = jnp.asarray(c_idx % 32, dtype=jnp.uint32)
+        unc = ((covered[:, word] >> bit) & 1) == 0
+        return cnt, unc
+
+    def _lowest_row(self, rowmask: jax.Array) -> jax.Array:
+        """[L, W_r] -> lowest set row index int32[L] (garbage -1 if empty)."""
+        first_w = jnp.argmax(rowmask != 0, axis=-1).astype(jnp.int32)
+        word = jnp.take_along_axis(rowmask, first_w[:, None], axis=-1)[:, 0]
+        low = lowest_bit(word)
+        bitpos = (31 - jax.lax.clz(low)).astype(jnp.int32)  # -1 if word == 0
+        return first_w * 32 + bitpos
+
+    def _take_row(
+        self, avail: jax.Array, covered: jax.Array, row: jax.Array, active: jax.Array
+    ):
+        """Select ``row`` where ``active``: cover its columns, drop conflicts."""
+        r = jnp.clip(row, 0, self.n_rows - 1)
+        new_avail = avail & ~jnp.asarray(self.elim)[r]
+        new_covered = covered | jnp.asarray(self.row_cols)[r]
+        return (
+            jnp.where(active[:, None], new_avail, avail),
+            jnp.where(active[:, None], new_covered, covered),
+        )
+
+    def _row_bit(self, row: jax.Array) -> jax.Array:
+        """int32[L] -> one-hot packed row mask uint32[L, W_r]."""
+        r = jnp.clip(row, 0, self.n_rows - 1)
+        w_idx = jnp.arange(self.w_rows, dtype=jnp.int32)
+        return jnp.where(
+            w_idx[None, :] == (r // 32)[:, None],
+            jnp.uint32(1) << (r % 32).astype(jnp.uint32)[:, None],
+            jnp.uint32(0),
+        )
+
+    # -- the three kernels ---------------------------------------------------
+    def propagate(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Take the unique row of any 1-candidate column, to a fixpoint.
+
+        One forced take per lane per sweep (lowest column first): simultaneous
+        takes could select two conflicting rows and corrupt the covered set,
+        so forcing is serialized per lane — sweeps are cheap tensor ops.
+        """
+        c_idx = jnp.arange(self.n_primary, dtype=jnp.int32)
+
+        def cond(s):
+            _, changed, k = s
+            return changed & (k < self.max_sweeps)
+
+        def body(s):
+            flat, _, k = s
+            avail, covered = self._split(flat)
+            cnt, unc = self._counts(avail, covered)
+            forced = unc & (cnt == 1)
+            has = jnp.any(forced, axis=-1)
+            col = jnp.argmin(jnp.where(forced, c_idx[None], _BIG), axis=-1)
+            rowmask = jnp.asarray(self.col_rows)[col] & avail
+            row = self._lowest_row(rowmask)
+            avail, covered = self._take_row(avail, covered, row, has)
+            return self._join(avail, covered), jnp.any(has), k + 1
+
+        states, _, sweeps = jax.lax.while_loop(
+            cond, body, (states, jnp.bool_(True), jnp.int32(0))
+        )
+        return states, sweeps
+
+    def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        avail, covered = self._split(states)
+        cnt, unc = self._counts(avail, covered)
+        contradiction = jnp.any(unc & (cnt == 0), axis=-1)
+        solved = ~jnp.any(unc, axis=-1) & ~contradiction
+        return solved, contradiction
+
+    def branch(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """MRV column; guess = take its lowest row, rest = exclude that row.
+
+        Candidate columns include cnt == 1: after a propagation fixpoint none
+        exist, but if ``max_sweeps`` capped the forced chain mid-way the
+        branch then *continues* it (rest is immediately contradictory), so an
+        undecided lane always has an active branch column — guess/rest childs
+        are a true partition in every reachable undecided state.
+        """
+        c_idx = jnp.arange(self.n_primary, dtype=jnp.int32)
+        avail, covered = self._split(states)
+        cnt, unc = self._counts(avail, covered)
+        branchable = unc & (cnt >= 1)
+        key = jnp.where(branchable, cnt * self.n_primary + c_idx[None], _BIG)
+        col = jnp.argmin(key, axis=-1)
+        rowmask = jnp.asarray(self.col_rows)[col] & avail
+        row = self._lowest_row(rowmask)
+        active = jnp.any(branchable, axis=-1)
+        g_avail, g_covered = self._take_row(avail, covered, row, active)
+        r_avail = jnp.where(
+            active[:, None], avail & ~self._row_bit(row), avail
+        )
+        return self._join(g_avail, g_covered), self._join(r_avail, covered)
+
+
+def build_cover(
+    name: str, incidence, n_primary: int, max_sweeps: int = 64
+) -> ExactCoverCSP:
+    """Build an instance from a bool incidence matrix [R, C_full].
+
+    Columns ``[0, n_primary)`` are primary (covered exactly once); the rest
+    are secondary (at most once, enforced purely through row conflicts).
+    Every row must cover at least one primary column — that is what makes
+    the chosen-rows decode invariant hold (see module docstring).
+    """
+    a = np.asarray(incidence, dtype=bool)
+    if a.ndim != 2:
+        raise ValueError(f"incidence must be 2-D, got {a.shape}")
+    n_rows = a.shape[0]
+    if not (0 < n_primary <= a.shape[1]):
+        raise ValueError(f"n_primary={n_primary} out of range for {a.shape}")
+    if not a[:, :n_primary].any(axis=1).all():
+        raise ValueError("every row must cover at least one primary column")
+    conflict = (a.astype(np.uint8) @ a.astype(np.uint8).T) > 0
+    np.fill_diagonal(conflict, False)
+    return ExactCoverCSP(
+        name=name,
+        n_rows=n_rows,
+        n_primary=n_primary,
+        col_rows=_pack_bits(a[:, :n_primary].T),
+        row_cols=_pack_bits(a[:, :n_primary]),
+        elim=_pack_bits(conflict),
+        max_sweeps=max_sweeps,
+    )
+
+
+def sudoku_cover(geom, max_sweeps: int = 64) -> ExactCoverCSP:
+    """Sudoku itself as exact cover: the cross-engine validation instance.
+
+    Row r*n*n + c*n + (d-1) = "digit d in cell (r, c)"; primary columns are
+    the 4n^2 classic constraints (cell filled, digit-in-row, digit-in-column,
+    digit-in-box).  Solving this with the cover kernels must agree with the
+    native Sudoku kernels (``models/sudoku.py``) — a strong mutual test of
+    two independent propagation/branching implementations on one engine.
+    Clue grids become root states via :meth:`ExactCoverCSP.state_with_rows_taken`
+    with :func:`sudoku_clue_rows`.
+    """
+    n = geom.n
+    a = np.zeros((n * n * n, 4 * n * n), dtype=bool)
+    for r in range(n):
+        for c in range(n):
+            b = (r // geom.box_h) * geom.n_hboxes + (c // geom.box_w)
+            for d in range(n):
+                row = r * n * n + c * n + d
+                a[row, r * n + c] = True  # cell (r, c) filled
+                a[row, n * n + r * n + d] = True  # digit d in row r
+                a[row, 2 * n * n + c * n + d] = True  # digit d in column c
+                a[row, 3 * n * n + b * n + d] = True  # digit d in box b
+    return build_cover(
+        f"sudoku-cover{geom.box_h}x{geom.box_w}", a, 4 * n * n, max_sweeps=max_sweeps
+    )
+
+
+def sudoku_clue_rows(grid) -> list[int]:
+    """Int clue grid [n, n] (0 = empty) -> cover row indices of the clues."""
+    grid = np.asarray(grid)
+    n = grid.shape[0]
+    return [
+        r * n * n + c * n + (int(grid[r, c]) - 1)
+        for r in range(n)
+        for c in range(n)
+        if grid[r, c] > 0
+    ]
+
+
+def decode_sudoku_cover(problem: ExactCoverCSP, solution_state, n: int) -> np.ndarray:
+    """Solved sudoku-cover state -> int grid [n, n]."""
+    grid = np.zeros((n, n), dtype=np.int32)
+    for row in problem.chosen_rows(solution_state):
+        row = int(row)
+        grid[row // (n * n), (row // n) % n] = row % n + 1
+    return grid

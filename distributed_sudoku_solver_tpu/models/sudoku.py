@@ -1,0 +1,83 @@
+"""Sudoku as a :class:`~distributed_sudoku_solver_tpu.ops.csp.CSProblem`.
+
+The flagship problem family: candidate-bitmask boards with elimination +
+hidden-singles propagation (``ops/propagate.py``) and binary digit
+branching.  This file is only the thin adapter between those kernels and
+the generic lane-stack engine; the search semantics match the reference's
+DFS (``/root/reference/DHT_Node.py:474-538``) as documented per-method.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import lowest_bit, popcount
+from distributed_sudoku_solver_tpu.ops.propagate import board_status, propagate
+
+
+@dataclasses.dataclass(frozen=True)
+class SudokuCSP:
+    """Sudoku-family CSP at a fixed geometry (jit-static, hashable).
+
+    ``branch``: 'minrem' picks the cell with fewest remaining candidates
+    (MRV, fastest); 'first' picks the first undecided cell row-major — the
+    reference's ``find_next_empty`` order (``/root/reference/utils.py:14-25``),
+    used by the bit-exactness tests.
+    """
+
+    geom: Geometry
+    branch_rule: str = "minrem"
+    max_sweeps: int = 64
+
+    def __post_init__(self) -> None:
+        if self.branch_rule not in ("minrem", "first"):
+            raise ValueError(f"unknown branch rule {self.branch_rule!r}")
+
+    @property
+    def state_shape(self) -> tuple[int, int]:
+        return (self.geom.n, self.geom.n)
+
+    def propagate(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        return propagate(states, self.geom, self.max_sweeps)
+
+    def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        st = board_status(states, self.geom)
+        return st.solved, st.contradiction
+
+    def branch(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Split one cell binarily: lowest candidate digit vs. the rest.
+
+        The guess child carries the *lowest* remaining digit (ascending
+        order, the reference's ``for number in arr`` at
+        ``/root/reference/DHT_Node.py:522``); the rest child keeps the other
+        candidates, so the two children partition the parent exactly.
+        """
+        onehot = self._branch_cell_onehot(states)
+        low = lowest_bit(states)
+        guess = jnp.where(onehot, low, states)
+        rest = jnp.where(onehot, states & ~low, states)
+        return guess, rest
+
+    def _branch_cell_onehot(self, cand: jax.Array) -> jax.Array:
+        """bool[L, n, n] one-hot of the cell to branch on per board."""
+        n = self.geom.n
+        lanes = cand.shape[0]
+        pc = popcount(cand).reshape(lanes, n * n).astype(jnp.int32)
+        cell_idx = jnp.arange(n * n, dtype=jnp.int32)
+        if self.branch_rule == "minrem":
+            key = jnp.where(pc > 1, pc * (n * n) + cell_idx, jnp.int32(2**30))
+        else:  # 'first'
+            key = jnp.where(pc > 1, cell_idx, jnp.int32(2**30))
+        chosen = jnp.argmin(key, axis=-1)
+        onehot = cell_idx[None, :] == chosen[:, None]
+        return onehot.reshape(lanes, n, n)
+
+    def signature(self) -> str:
+        return (
+            f"sudoku:{self.geom.box_h}x{self.geom.box_w}"
+            f":{self.branch_rule}:{self.max_sweeps}"
+        )

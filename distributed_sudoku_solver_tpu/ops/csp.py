@@ -1,0 +1,60 @@
+"""The problem interface of the lane-stack engine: what a CSP must provide.
+
+The reference hard-wires one problem (9x9 Sudoku) into its only kernel
+(``/root/reference/utils.py:14-55``).  Here the search engine
+(``ops/frontier.py``: DFS lane stacks, work stealing, cancellation, and the
+multi-chip path in ``parallel/sharded.py``) is generic over a *problem*
+object, so every family — Sudoku at any geometry, generalized exact cover
+(N-queens, pentomino), and future CSPs — shares one compiled scheduler.
+
+A problem owns the meaning of a *state*: one immutable ``uint32[h, w]``
+tensor per search node (a Sudoku candidate board; a packed avail/covered
+pair for exact cover).  The engine never looks inside states — it only
+stacks, ships, and hands them back to the problem's three kernels, each
+batched over a leading lane axis:
+
+* ``propagate(states) -> (states, sweeps)``: run inference to a fixpoint
+  (pure, monotonic: may only restrict states).
+* ``status(states) -> (solved, contradiction)``: classify each state;
+  neither flag set means "undecided, branch me".
+* ``branch(states) -> (guess, rest)``: split each state into two children
+  whose search spaces partition the parent's (guess is explored first —
+  DFS).  Values for non-undecided lanes are ignored by the engine, so the
+  kernels must be *total*: garbage in, garbage out, never NaN/crash.
+
+Problem objects are jit-static: they must be hashable and equality-stable
+(two equal problems must trace identically), and any instance tensors they
+close over are baked into the compiled program as constants.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import jax
+
+
+@runtime_checkable
+class CSProblem(Protocol):
+    """Static problem definition consumed by the frontier engine."""
+
+    @property
+    def state_shape(self) -> tuple[int, int]:
+        """(h, w) of one search state; states are uint32[..., h, w]."""
+        ...
+
+    def propagate(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """[L, h, w] -> (restricted states, int32 sweep count)."""
+        ...
+
+    def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """[L, h, w] -> (solved bool[L], contradiction bool[L])."""
+        ...
+
+    def branch(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """[L, h, w] -> (guess, rest): two children partitioning the parent."""
+        ...
+
+    def signature(self) -> str:
+        """Stable identity string (checkpoint compatibility checks)."""
+        ...

@@ -29,6 +29,11 @@ unlike a flat expansion pool the frontier cannot deadlock at capacity; a
 stack that would overflow S drops its *rest* sibling and records the loss
 per job (``overflowed``), downgrading a would-be "unsat" verdict to
 "unknown" rather than ever reporting wrongly.
+
+The engine is generic over the problem family (``ops/csp.py``): states are
+opaque ``uint32[h, w]`` tensors, and propagation / classification /
+branching are the problem's three kernels.  Sudoku lives in
+``models/sudoku.py``; generalized exact cover in ``models/cover.py``.
 """
 
 from __future__ import annotations
@@ -39,9 +44,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from distributed_sudoku_solver_tpu.models.geometry import Geometry
-from distributed_sudoku_solver_tpu.ops.bitmask import lowest_bit, popcount
-from distributed_sudoku_solver_tpu.ops.propagate import board_status, propagate
+from distributed_sudoku_solver_tpu.ops.csp import CSProblem
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,8 +55,8 @@ class SolverConfig:
     min_lanes: int = 64  # speculation width floor for small job counts
     stack_slots: int = 64  # DFS stack depth per lane
     max_steps: int = 100_000  # branch rounds before giving up
-    max_sweeps: int = 64  # propagation sweeps per fixpoint
-    branch: str = "minrem"  # 'minrem' (fastest) | 'first' (reference order)
+    max_sweeps: int = 64  # propagation sweeps per fixpoint (Sudoku adapter)
+    branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref order)
     steal: bool = True  # receiver-initiated work stealing between lanes
     ring_steal_k: int = 8  # max boards shipped per step per chip pair (sharded)
 
@@ -67,11 +70,11 @@ class SolverConfig:
 class Frontier(NamedTuple):
     """Loop-carried device state for one solve call."""
 
-    stack: jax.Array  # uint32[L, S, n, n] candidate masks
+    stack: jax.Array  # uint32[L, S, h, w] problem states
     sp: jax.Array  # int32[L] stack pointer (0 = empty lane)
     job: jax.Array  # int32[L] owning job; -1 = unassigned
     solved: jax.Array  # bool[J]
-    solution: jax.Array  # uint32[J, n, n] (candidate form; all singles)
+    solution: jax.Array  # uint32[J, h, w] (solved problem state)
     overflowed: jax.Array  # bool[J] some subtree was dropped (stack full)
     nodes: jax.Array  # int32[J] branch nodes expanded per job
     steps: jax.Array  # int32 scalar
@@ -80,8 +83,8 @@ class Frontier(NamedTuple):
     steals: jax.Array  # int32 scalar total bottom-steals
 
 
-def init_frontier(cand0: jax.Array, config: SolverConfig) -> Frontier:
-    """Seed each job's root board into its own lane (the root TASK self-send,
+def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
+    """Seed each job's root state into its own lane (the root TASK self-send,
     ``/root/reference/DHT_Node.py:551``); extra lanes start as thieves.
 
     Seed lanes are *strided* across the lane axis — floor(j*L/J), strictly
@@ -89,12 +92,12 @@ def init_frontier(cand0: jax.Array, config: SolverConfig) -> Frontier:
     every chip starts with its share of root jobs instead of chip 0 holding
     everything.
     """
-    n_jobs, n, _ = cand0.shape
+    n_jobs, h, w = states0.shape
     n_lanes = config.resolve_lanes(n_jobs)
     s = config.stack_slots
     seed_lane = (jnp.arange(n_jobs, dtype=jnp.int32) * n_lanes) // n_jobs
-    stack = jnp.zeros((n_lanes, s, n, n), jnp.uint32)
-    stack = stack.at[seed_lane, 0].set(cand0.astype(jnp.uint32))
+    stack = jnp.zeros((n_lanes, s, h, w), jnp.uint32)
+    stack = stack.at[seed_lane, 0].set(states0.astype(jnp.uint32))
     sp = jnp.zeros(n_lanes, jnp.int32).at[seed_lane].set(1)
     job = jnp.full(n_lanes, -1, jnp.int32).at[seed_lane].set(
         jnp.arange(n_jobs, dtype=jnp.int32)
@@ -104,7 +107,7 @@ def init_frontier(cand0: jax.Array, config: SolverConfig) -> Frontier:
         sp=sp,
         job=job,
         solved=jnp.zeros(n_jobs, bool),
-        solution=jnp.zeros((n_jobs, n, n), jnp.uint32),
+        solution=jnp.zeros((n_jobs, h, w), jnp.uint32),
         overflowed=jnp.zeros(n_jobs, bool),
         nodes=jnp.zeros(n_jobs, jnp.int32),
         steps=jnp.int32(0),
@@ -112,27 +115,6 @@ def init_frontier(cand0: jax.Array, config: SolverConfig) -> Frontier:
         expansions=jnp.int32(0),
         steals=jnp.int32(0),
     )
-
-
-def _branch_cell_onehot(cand: jax.Array, branch: str) -> jax.Array:
-    """bool[L, n, n] one-hot of the cell to branch on per board.
-
-    'minrem': fewest remaining candidates (ties -> first row-major) — MRV.
-    'first': first undecided cell row-major — the reference's
-    ``find_next_empty`` order (``/root/reference/utils.py:14-25``).
-    """
-    lanes, n, _ = cand.shape
-    pc = popcount(cand).reshape(lanes, n * n).astype(jnp.int32)
-    cell_idx = jnp.arange(n * n, dtype=jnp.int32)
-    if branch == "minrem":
-        key = jnp.where(pc > 1, pc * (n * n) + cell_idx, jnp.int32(2**30))
-    elif branch == "first":
-        key = jnp.where(pc > 1, cell_idx, jnp.int32(2**30))
-    else:  # pragma: no cover - config validation
-        raise ValueError(f"unknown branch mode {branch!r}")
-    chosen = jnp.argmin(key, axis=-1)
-    onehot = cell_idx[None, :] == chosen[:, None]
-    return onehot.reshape(lanes, n, n)
 
 
 def _steal(
@@ -175,9 +157,11 @@ def _steal(
     return stack, sp, job, n_pairs
 
 
-def frontier_step(state: Frontier, geom: Geometry, config: SolverConfig) -> Frontier:
+def frontier_step(
+    state: Frontier, problem: CSProblem, config: SolverConfig
+) -> Frontier:
     """One lockstep round: pop+propagate tops -> harvest/cancel -> branch -> steal."""
-    n_lanes, s, n, _ = state.stack.shape
+    n_lanes, s = state.stack.shape[:2]
     n_jobs = state.solved.shape[0]
     lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
 
@@ -191,10 +175,10 @@ def frontier_step(state: Frontier, geom: Geometry, config: SolverConfig) -> Fron
     top_idx = jnp.clip(sp - 1, 0, s - 1)
     tops = state.stack[lane_idx, top_idx]
     tops = jnp.where(live[:, None, None], tops, 0)  # idle tops are inert zeros
-    tops, sweeps = propagate(tops, geom, config.max_sweeps)
-    status = board_status(tops, geom)
-    solved_tops = status.solved & live
-    contra_tops = status.contradiction & live
+    tops, sweeps = problem.propagate(tops)
+    top_solved, top_contra = problem.status(tops)
+    solved_tops = top_solved & live
+    contra_tops = top_contra & live
     undecided = live & ~solved_tops & ~contra_tops
 
     # --- harvest solutions: deterministic lowest-lane winner per job --------
@@ -208,10 +192,7 @@ def frontier_step(state: Frontier, geom: Geometry, config: SolverConfig) -> Fron
     solved = state.solved | newly
 
     # --- branch: replace parent with `rest`, push `guess` on top ------------
-    onehot = _branch_cell_onehot(tops, config.branch)
-    low = lowest_bit(tops)
-    guess = jnp.where(onehot, low, tops)
-    rest = jnp.where(onehot, tops & ~low, tops)
+    guess, rest = problem.branch(tops)
 
     full_stack = sp >= s
     push = undecided & ~full_stack
@@ -271,7 +252,7 @@ def frontier_live(state: Frontier) -> jax.Array:
 
 def run_frontier(
     state: Frontier,
-    geom: Geometry,
+    problem: CSProblem,
     config: SolverConfig,
     step_limit: jax.Array | None = None,
 ) -> Frontier:
@@ -287,4 +268,6 @@ def run_frontier(
     def cond(st: Frontier):
         return jnp.any(frontier_live(st)) & (st.steps < limit)
 
-    return jax.lax.while_loop(cond, lambda s: frontier_step(s, geom, config), state)
+    return jax.lax.while_loop(
+        cond, lambda s: frontier_step(s, problem, config), state
+    )

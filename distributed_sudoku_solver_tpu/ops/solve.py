@@ -20,7 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.models.sudoku import SudokuCSP
 from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid, encode_grid
+from distributed_sudoku_solver_tpu.ops.csp import CSProblem
 from distributed_sudoku_solver_tpu.ops.frontier import (
     Frontier,
     SolverConfig,
@@ -31,7 +33,8 @@ from distributed_sudoku_solver_tpu.ops.frontier import (
 
 
 class SolveResult(NamedTuple):
-    solution: jax.Array  # int32[J, n, n]; all-zero rows for unsat/unknown jobs
+    solution: jax.Array  # solved state per job (int32 grid for Sudoku entry
+    #   points; raw uint32[h, w] problem state for solve_csp); zeros if unsolved
     solved: jax.Array  # bool[J]
     unsat: jax.Array  # bool[J]: proven unsatisfiable
     overflowed: jax.Array  # bool[J]: a subtree was dropped (stack overflow)
@@ -42,17 +45,15 @@ class SolveResult(NamedTuple):
     steals: jax.Array  # int32 total lane-to-lane work steals
 
 
-def _finalize(state: Frontier) -> SolveResult:
+def finalize_frontier(state: Frontier) -> SolveResult:
+    """Frontier -> verdicts; the solution stays in raw problem-state form."""
     n_jobs = state.solved.shape[0]
     live = frontier_live(state)
     job_safe = jnp.clip(state.job, 0, n_jobs - 1)
     job_has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(live, mode="drop")
     unsat = ~state.solved & ~job_has_work & ~state.overflowed
-    solution = jnp.where(
-        state.solved[:, None, None], decode_grid(state.solution), jnp.int32(0)
-    )
     return SolveResult(
-        solution=solution,
+        solution=state.solution,
         solved=state.solved,
         unsat=unsat,
         overflowed=state.overflowed,
@@ -64,6 +65,35 @@ def _finalize(state: Frontier) -> SolveResult:
     )
 
 
+def _decode_solution(res: SolveResult) -> SolveResult:
+    """Sudoku entry points return int grids, not candidate masks."""
+    solution = jnp.where(
+        res.solved[:, None, None], decode_grid(res.solution), jnp.int32(0)
+    )
+    return res._replace(solution=solution)
+
+
+def _finalize(state: Frontier) -> SolveResult:
+    return _decode_solution(finalize_frontier(state))
+
+
+def sudoku_csp(geom: Geometry, config: SolverConfig) -> SudokuCSP:
+    """The Sudoku problem a (geom, config) pair denotes — one place, everywhere."""
+    return SudokuCSP(
+        geom=geom, branch_rule=config.branch, max_sweeps=config.max_sweeps
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("problem", "config"))
+def solve_csp(
+    states0: jax.Array, problem: CSProblem, config: SolverConfig = SolverConfig()
+) -> SolveResult:
+    """Solve root states [J, h, w] of any CSP; solution is the raw solved state."""
+    state = init_frontier(states0, config)
+    state = run_frontier(state, problem, config)
+    return finalize_frontier(state)
+
+
 @functools.partial(jax.jit, static_argnames=("geom", "config"))
 def solve_batch(
     grids: jax.Array, geom: Geometry, config: SolverConfig = SolverConfig()
@@ -71,7 +101,7 @@ def solve_batch(
     """Solve int grids [J, n, n] (0 = empty); one compiled program per (J, geom, config)."""
     cand0 = encode_grid(grids, geom)
     state = init_frontier(cand0, config)
-    state = run_frontier(state, geom, config)
+    state = run_frontier(state, sudoku_csp(geom, config), config)
     return _finalize(state)
 
 

@@ -7,4 +7,5 @@ from distributed_sudoku_solver_tpu.parallel.mesh import (  # noqa: F401
 )
 from distributed_sudoku_solver_tpu.parallel.sharded import (  # noqa: F401
     solve_batch_sharded,
+    solve_csp_sharded,
 )

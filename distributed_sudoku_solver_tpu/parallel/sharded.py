@@ -41,6 +41,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
 from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+from distributed_sudoku_solver_tpu.ops.csp import CSProblem
 from distributed_sudoku_solver_tpu.ops.frontier import (
     Frontier,
     SolverConfig,
@@ -48,7 +49,12 @@ from distributed_sudoku_solver_tpu.ops.frontier import (
     frontier_step,
     init_frontier,
 )
-from distributed_sudoku_solver_tpu.ops.solve import SolveResult, _finalize
+from distributed_sudoku_solver_tpu.ops.solve import (
+    SolveResult,
+    _decode_solution,
+    finalize_frontier,
+    sudoku_csp,
+)
 from distributed_sudoku_solver_tpu.parallel.mesh import LANE_AXIS, default_mesh
 
 
@@ -68,7 +74,7 @@ def _ring_steal(
     idle count cannot have shrunk in between — nothing else touches it).
     """
     n_dev = jax.lax.axis_size(axis)
-    n_lanes, s, n, _ = stack.shape
+    n_lanes = stack.shape[0]
     k = min(k, n_lanes)
     lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
     slot_k = jnp.arange(k, dtype=jnp.int32)
@@ -109,7 +115,7 @@ def _ring_steal(
 
 
 def _sharded_step(
-    state: Frontier, geom: Geometry, config: SolverConfig, axis: str
+    state: Frontier, problem: CSProblem, config: SolverConfig, axis: str
 ) -> Frontier:
     """One lockstep round on every chip: local step, then cross-chip merges."""
     n_jobs = state.solved.shape[0]
@@ -117,7 +123,7 @@ def _sharded_step(
     prev_solved = state.solved
     prev_solution = state.solution
 
-    st = frontier_step(state, geom, config)
+    st = frontier_step(state, problem, config)
 
     # --- merge job resolution across chips (the SOLUTION_FOUND broadcast) ---
     newly = st.solved & ~prev_solved
@@ -162,7 +168,7 @@ def _sharded_step(
 
 
 def _run_sharded(
-    state: Frontier, geom: Geometry, config: SolverConfig, axis: str
+    state: Frontier, problem: CSProblem, config: SolverConfig, axis: str
 ) -> SolveResult:
     """Per-chip body: the whole solve loop plus the finalize collectives."""
 
@@ -171,11 +177,11 @@ def _run_sharded(
         return (jax.lax.psum(local_live, axis) > 0) & (st.steps < config.max_steps)
 
     state = jax.lax.while_loop(
-        cond, lambda st: _sharded_step(st, geom, config, axis), state
+        cond, lambda st: _sharded_step(st, problem, config, axis), state
     )
 
     # Per-chip counters -> global (the STATS aggregation, as one psum).
-    res = _finalize(state)
+    res = finalize_frontier(state)
     live_local = frontier_live(state)
     n_jobs = state.solved.shape[0]
     job_safe = jnp.clip(state.job, 0, n_jobs - 1)
@@ -195,11 +201,11 @@ def _run_sharded(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("geom", "config", "mesh"))
-def _solve_sharded_jit(
-    grids: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
+@functools.partial(jax.jit, static_argnames=("problem", "config", "mesh"))
+def _solve_csp_sharded_jit(
+    states0: jax.Array, problem: CSProblem, config: SolverConfig, mesh: Mesh
 ) -> SolveResult:
-    n_jobs = grids.shape[0]
+    n_jobs = states0.shape[0]
     (axis,) = mesh.axis_names
     n_dev = mesh.devices.size
 
@@ -209,8 +215,7 @@ def _solve_sharded_jit(
     lanes = -(-lanes // n_dev) * n_dev
     cfg = dataclasses.replace(config, lanes=lanes)
 
-    cand0 = encode_grid(grids, geom)
-    state = init_frontier(cand0, cfg)
+    state = init_frontier(states0, cfg)
 
     lane_specs = Frontier(
         stack=P(axis),
@@ -237,13 +242,38 @@ def _solve_sharded_jit(
         steals=P(),
     )
     body = jax.shard_map(
-        functools.partial(_run_sharded, geom=geom, config=cfg, axis=axis),
+        functools.partial(_run_sharded, problem=problem, config=cfg, axis=axis),
         mesh=mesh,
         in_specs=(lane_specs,),
         out_specs=out_specs,
         check_vma=False,
     )
     return body(state)
+
+
+def solve_csp_sharded(
+    states0,
+    problem: CSProblem,
+    config: SolverConfig = SolverConfig(),
+    mesh: Mesh | None = None,
+) -> SolveResult:
+    """Solve root states [J, h, w] of any CSP, lanes sharded over ``mesh``.
+
+    The solution field stays in raw problem-state form (like
+    :func:`~distributed_sudoku_solver_tpu.ops.solve.solve_csp`).
+    """
+    mesh = mesh if mesh is not None else default_mesh()
+    return _solve_csp_sharded_jit(jnp.asarray(states0), problem, config, mesh)
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config", "mesh"))
+def _solve_sharded_jit(
+    grids: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
+) -> SolveResult:
+    res = _solve_csp_sharded_jit(
+        encode_grid(grids, geom), sudoku_csp(geom, config), config, mesh
+    )
+    return _decode_solution(res)
 
 
 def solve_batch_sharded(

@@ -35,7 +35,7 @@ from distributed_sudoku_solver_tpu.ops.frontier import (
     init_frontier,
     run_frontier,
 )
-from distributed_sudoku_solver_tpu.ops.solve import SolveResult, _finalize
+from distributed_sudoku_solver_tpu.ops.solve import SolveResult, _finalize, sudoku_csp
 
 
 @functools.partial(jax.jit, static_argnames=("geom", "config"))
@@ -48,7 +48,7 @@ def advance_frontier(
     state: Frontier, step_limit: jax.Array, geom: Geometry, config: SolverConfig
 ) -> Frontier:
     """Run until every job resolves or ``state.steps`` reaches ``step_limit``."""
-    return run_frontier(state, geom, config, step_limit=step_limit)
+    return run_frontier(state, sudoku_csp(geom, config), config, step_limit=step_limit)
 
 
 def frontier_done(state: Frontier) -> bool:
@@ -60,7 +60,7 @@ def _signature(
 ) -> str:
     return json.dumps(
         {
-            "geom": [geom.box_h, geom.box_w],
+            "problem": sudoku_csp(geom, config).signature(),
             "config": dataclasses.asdict(config),
             "grids": grids_hash,
         }

@@ -1,0 +1,104 @@
+"""Exact-cover family on the generic engine (BASELINE.json config 5).
+
+The reference solves exactly one problem shape; these tests pin the second
+family — generalized exact cover (primary/secondary columns) — on the same
+lane-stack engine and the same multi-chip sharded path, including the
+mutual cross-check of solving *Sudoku itself* through the cover kernels.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.cover import (
+    decode_sudoku_cover,
+    sudoku_clue_rows,
+    sudoku_cover,
+)
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_4, SUDOKU_9
+from distributed_sudoku_solver_tpu.models.nqueens import (
+    decode_queens,
+    is_valid_queens,
+    nqueens_cover,
+)
+from distributed_sudoku_solver_tpu.models.pentomino import (
+    decode_tiling,
+    is_valid_tiling,
+    pentomino_cover,
+)
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch, solve_csp
+from distributed_sudoku_solver_tpu.parallel import make_mesh, solve_csp_sharded
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+CFG = SolverConfig(min_lanes=16, stack_slots=128, max_steps=20_000)
+
+
+def _roots(problem, n_jobs=1):
+    return np.repeat(problem.initial_state()[None], n_jobs, axis=0)
+
+
+@pytest.mark.parametrize("n", [4, 6, 8, 12])
+def test_nqueens_solved_and_valid(n):
+    p = nqueens_cover(n)
+    res = solve_csp(_roots(p), p, CFG)
+    assert bool(res.solved[0])
+    queens = decode_queens(p, np.asarray(res.solution[0]), n)
+    assert is_valid_queens(queens, n)
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_nqueens_unsat_proven(n):
+    p = nqueens_cover(n)
+    res = solve_csp(_roots(p), p, SolverConfig(min_lanes=8, stack_slots=32))
+    assert not bool(res.solved[0])
+    assert bool(res.unsat[0])
+    assert not bool(res.overflowed[0])
+
+
+def test_pentomino_6x10_tiling():
+    p = pentomino_cover(6, 10)
+    cfg = SolverConfig(min_lanes=64, stack_slots=256, max_steps=50_000)
+    res = solve_csp(_roots(p), p, cfg)
+    assert bool(res.solved[0])
+    grid = decode_tiling(p, np.asarray(res.solution[0]), 6, 10)
+    assert is_valid_tiling(grid)
+
+
+def test_sudoku_through_cover_engine_matches_native_kernel():
+    """Solving Sudoku as exact cover must agree with the Sudoku kernels."""
+    p = sudoku_cover(SUDOKU_9)
+    root = p.state_with_rows_taken(sudoku_clue_rows(EASY_9))[None]
+    res = solve_csp(root, p, CFG)
+    assert bool(res.solved[0])
+    via_cover = decode_sudoku_cover(p, np.asarray(res.solution[0]), 9)
+    native = solve_batch(np.asarray(EASY_9, np.int32)[None], SUDOKU_9, CFG)
+    assert np.array_equal(via_cover, np.asarray(native.solution[0]))
+
+
+def test_cover_rejects_conflicting_clues():
+    p = sudoku_cover(SUDOKU_4)
+    grid = np.zeros((4, 4), np.int32)
+    grid[0, 0] = 1
+    grid[0, 1] = 1  # same digit twice in a row
+    with pytest.raises(ValueError):
+        p.state_with_rows_taken(sudoku_clue_rows(grid))
+
+
+def test_nqueens_batch_multiple_jobs():
+    """Several independent cover jobs share one frontier batch."""
+    p = nqueens_cover(8)
+    res = solve_csp(_roots(p, 4), p, CFG)
+    assert np.asarray(res.solved).all()
+    for j in range(4):
+        q = decode_queens(p, np.asarray(res.solution[j]), 8)
+        assert is_valid_queens(q, 8)
+
+
+def test_cover_sharded_on_mesh():
+    """The multi-chip path runs the cover family unchanged (8 CPU devices)."""
+    p = nqueens_cover(10)
+    cfg = SolverConfig(min_lanes=16, stack_slots=64, max_steps=20_000, ring_steal_k=4)
+    res = solve_csp_sharded(_roots(p), p, cfg, mesh=make_mesh())
+    assert bool(res.solved[0])
+    q = decode_queens(p, np.asarray(res.solution[0]), 10)
+    assert is_valid_queens(q, 10)
