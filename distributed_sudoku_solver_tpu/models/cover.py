@@ -265,7 +265,9 @@ def build_cover(
         raise ValueError(f"n_primary={n_primary} out of range for {a.shape}")
     if not a[:, :n_primary].any(axis=1).all():
         raise ValueError("every row must cover at least one primary column")
-    conflict = (a.astype(np.uint8) @ a.astype(np.uint8).T) > 0
+    # int32 accumulation: a uint8 matmul wraps at 256 shared columns and
+    # would silently drop that pair's conflict.
+    conflict = (a.astype(np.int32) @ a.astype(np.int32).T) > 0
     np.fill_diagonal(conflict, False)
     return ExactCoverCSP(
         name=name,

@@ -5,6 +5,12 @@ from distributed_sudoku_solver_tpu.parallel.mesh import (  # noqa: F401
     default_mesh,
     make_mesh,
 )
+from distributed_sudoku_solver_tpu.parallel.board_sharded import (  # noqa: F401
+    BAND_AXIS,
+    BandedSudoku,
+    make_band_mesh,
+    solve_batch_banded,
+)
 from distributed_sudoku_solver_tpu.parallel.sharded import (  # noqa: F401
     solve_batch_sharded,
     solve_csp_sharded,

@@ -1,0 +1,408 @@
+"""Board-sharded solving: one giant board's candidate tensor split over chips.
+
+This is the framework's sequence/context-parallelism axis (SURVEY.md §5.7).
+The lane-sharded path (``parallel/sharded.py``) scales the *number* of search
+states over chips — the heir of the reference's guess-range splitting
+(``/root/reference/DHT_Node.py:499-510``).  This module scales the *problem
+dimension itself*: for giant geometries (25x25 and up) every chip owns a
+horizontal band of the board, and each propagation sweep exchanges per-column
+candidate aggregates with the other chips over ICI — structurally ring
+attention's neighbor-exchange loop, with column constraint masks in place of
+KV blocks.
+
+Sharding layout (chosen so exactly ONE of the three unit families crosses
+chips):
+
+* The board's rows are grouped into **vertical box bands** of ``box_h`` rows;
+  bands are padded up to a multiple of the mesh size and dealt contiguously,
+  ``bands_per_chip`` to a chip.  Row units and box units then live entirely
+  inside one chip's shard.
+* Only **column units** span chips.  Their bitwise OR / once-twice aggregates
+  are reduced with an explicit ``ppermute`` ring all-reduce
+  (:func:`ring_or`, :func:`ring_once_twice`): D-1 hops of an [L, n] uint32
+  tile around the ICI ring — a few KB per hop.
+
+The generic lane-stack engine (``ops/frontier.py``) runs *unchanged* inside
+``shard_map``: lane/stack bookkeeping is replicated, board tensors are
+sharded on their row axis, and all cross-chip talk happens inside the
+problem kernels below.  Because every collective is an all-reduce, each chip
+ends every step with identical replicated state, so the engine's control
+flow stays in lockstep — and results (solutions, node counts, branch order)
+are bit-identical to the single-device solver, which the tests assert.
+
+Pad rows hold the empty mask 0 (no candidates): they contribute the identity
+to every OR/once-twice aggregate, are never branch candidates (popcount 0),
+and are masked out of the solved/contradiction checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import (
+    decode_grid,
+    encode_grid,
+    is_single,
+    lowest_bit,
+    once_twice_reduce,
+    or_reduce,
+    popcount,
+)
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    init_frontier,
+    run_frontier,
+)
+from distributed_sudoku_solver_tpu.ops.solve import SolveResult, finalize_frontier
+from distributed_sudoku_solver_tpu.parallel.mesh import make_mesh
+
+# Mesh axis the board's row-band dimension is sharded over.
+BAND_AXIS = "bands"
+
+
+def make_band_mesh(devices=None) -> Mesh:
+    """A 1-D mesh whose axis shards board row-bands (the SP/ring axis)."""
+    return make_mesh(devices, axis_name=BAND_AXIS)
+
+
+# --------------------------------------------------------------------------
+# Ring all-reduces: the neighbor-exchange loop (ring attention's comm shape).
+# --------------------------------------------------------------------------
+
+
+def _ring_perm(n_dev: int) -> list[tuple[int, int]]:
+    return [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+
+def ring_or(x: jax.Array, axis: str, n_dev: int) -> jax.Array:
+    """Bitwise-OR all-reduce over the mesh axis via D-1 ring hops.
+
+    Each hop forwards the accumulated tile to the ring successor over ICI;
+    after D-1 hops every chip holds the global OR.  (XLA's ``all_reduce``
+    would lower to the same ring on a 1-D ICI torus; spelling it out keeps
+    the data path explicit and lets the combiner generalize below.)
+    """
+    acc, buf = x, x
+    perm = _ring_perm(n_dev)
+    for _ in range(n_dev - 1):
+        buf = jax.lax.ppermute(buf, axis, perm)
+        acc = acc | buf
+    return acc
+
+
+def ring_once_twice(
+    once: jax.Array, twice: jax.Array, axis: str, n_dev: int
+) -> tuple[jax.Array, jax.Array]:
+    """All-reduce per-chip (once, twice) column aggregates around the ring.
+
+    Combiner ((o1,t1),(o2,t2)) -> (o1|o2, t1|t2|(o1&o2)) — associative and
+    commutative, so rotate-and-accumulate yields the exact global aggregate
+    on every chip (``ops/bitmask.py`` ``once_twice_reduce``'s combiner).
+    """
+    acc_o, acc_t = once, twice
+    buf_o, buf_t = once, twice
+    perm = _ring_perm(n_dev)
+    for _ in range(n_dev - 1):
+        buf_o = jax.lax.ppermute(buf_o, axis, perm)
+        buf_t = jax.lax.ppermute(buf_t, axis, perm)
+        acc_o, acc_t = acc_o | buf_o, acc_t | buf_t | (acc_o & buf_o)
+    return acc_o, acc_t
+
+
+def _psum_any(x: jax.Array, axis: str) -> jax.Array:
+    """Logical-OR all-reduce of a bool array over the mesh axis."""
+    return jax.lax.psum(x.astype(jnp.int32), axis) > 0
+
+
+# --------------------------------------------------------------------------
+# The banded problem: Sudoku whose states are row-band shards.
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BandedSudoku:
+    """Sudoku CSP over row-band shards of the board (jit-static, hashable).
+
+    Implements the :class:`~distributed_sudoku_solver_tpu.ops.csp.CSProblem`
+    protocol, but its kernels run *inside* ``shard_map``: states are the
+    local shard ``uint32[L, rows_local, n]`` and the column-unit reductions
+    are ring collectives over ``axis``.  Branch order matches
+    :class:`~distributed_sudoku_solver_tpu.models.sudoku.SudokuCSP` exactly
+    (same key, globally row-major cell index), so searches are bit-identical
+    to the unsharded engine.
+    """
+
+    geom: Geometry
+    axis: str
+    n_dev: int
+    bands_per_chip: int
+    branch_rule: str = "minrem"
+    max_sweeps: int = 64
+
+    @property
+    def rows_local(self) -> int:
+        return self.bands_per_chip * self.geom.box_h
+
+    @property
+    def rows_padded(self) -> int:
+        return self.rows_local * self.n_dev
+
+    @property
+    def state_shape(self) -> tuple[int, int]:
+        """Global (padded) state shape; each chip holds a 1/n_dev row slice."""
+        return (self.rows_padded, self.geom.n)
+
+    # -- local geometry helpers ------------------------------------------
+
+    def _to_boxes(self, x: jax.Array) -> jax.Array:
+        """[..., rows_local, n] -> [..., local boxes, box cells] (chip-local)."""
+        g = self.geom
+        lead = x.shape[:-2]
+        x = x.reshape(*lead, self.bands_per_chip, g.box_h, g.n_hboxes, g.box_w)
+        x = jnp.swapaxes(x, -3, -2)
+        return x.reshape(*lead, self.bands_per_chip * g.n_hboxes, g.n)
+
+    def _from_boxes(self, x: jax.Array) -> jax.Array:
+        g = self.geom
+        lead = x.shape[:-2]
+        x = x.reshape(*lead, self.bands_per_chip, g.n_hboxes, g.box_h, g.box_w)
+        x = jnp.swapaxes(x, -3, -2)
+        return x.reshape(*lead, self.rows_local, g.n)
+
+    def _row_valid(self) -> jax.Array:
+        """bool[rows_local]: which local rows are real board rows (not pad)."""
+        chip = jax.lax.axis_index(self.axis).astype(jnp.int32)
+        grow = chip * self.rows_local + jnp.arange(self.rows_local, dtype=jnp.int32)
+        return grow < self.geom.n
+
+    def _box_valid(self) -> jax.Array:
+        """bool[local boxes]: boxes in real (non-pad) bands."""
+        chip = jax.lax.axis_index(self.axis).astype(jnp.int32)
+        band = chip * self.bands_per_chip + (
+            jnp.arange(self.bands_per_chip * self.geom.n_hboxes, dtype=jnp.int32)
+            // self.geom.n_hboxes
+        )
+        return band < self.geom.n_vboxes
+
+    # -- propagation ------------------------------------------------------
+
+    def _sweep(self, cand: jax.Array) -> jax.Array:
+        """One sweep of ``ops/propagate.py``'s rules, columns ring-reduced."""
+        single = is_single(cand)
+        decided = jnp.where(single, cand, jnp.uint32(0))
+
+        # Elimination: decided digits disappear from their row/box (local)
+        # and column (one ring OR over the mesh axis).
+        row_or = or_reduce(decided, -1)[..., None]
+        box_or = or_reduce(self._to_boxes(decided), -1)[..., None]
+        box_seen = self._from_boxes(
+            jnp.broadcast_to(box_or, (*box_or.shape[:-1], self.geom.n))
+        )
+        col_part = or_reduce(decided, -2)  # [L, n] this chip's rows
+        col_or = ring_or(col_part, self.axis, self.n_dev)
+        seen = row_or | box_seen | col_or[..., None, :]
+        cand = jnp.where(single, cand, cand & ~seen)
+
+        # Hidden singles: digits with a unique home in a unit are forced.
+        forced = jnp.zeros_like(cand)
+        r_once, r_twice = once_twice_reduce(cand, -1)
+        unique = (r_once & ~r_twice)[..., None]
+        forced = forced | (cand & unique)
+        boxes = self._to_boxes(cand)
+        b_once, b_twice = once_twice_reduce(boxes, -1)
+        b_unique = (b_once & ~b_twice)[..., None]
+        forced = forced | self._from_boxes(
+            boxes & jnp.broadcast_to(b_unique, boxes.shape)
+        )
+        c_once, c_twice = once_twice_reduce(cand, -2)  # [L, n] local partials
+        c_once, c_twice = ring_once_twice(c_once, c_twice, self.axis, self.n_dev)
+        c_unique = (c_once & ~c_twice)[..., None, :]
+        forced = forced | (cand & c_unique)
+        return jnp.where(~single & (forced != 0), forced, cand)
+
+    def propagate(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Sweep to a fixpoint; the 'changed' flag is globally agreed (psum)
+        so every chip runs the same number of ring exchanges."""
+
+        def cond(s):
+            _, changed, sweeps = s
+            return changed & (sweeps < self.max_sweeps)
+
+        def body(s):
+            cur, _, sweeps = s
+            nxt = self._sweep(cur)
+            changed = _psum_any(jnp.any(nxt != cur), self.axis)
+            return nxt, changed, sweeps + 1
+
+        states, _, sweeps = jax.lax.while_loop(
+            cond, body, (states, jnp.bool_(True), jnp.int32(0))
+        )
+        return states, sweeps
+
+    # -- classification ---------------------------------------------------
+
+    def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """(solved, contradiction) per lane — identical on every chip.
+
+        Same rules as ``ops/propagate.py`` ``board_status`` (the corrected
+        ``Sudoku.check``, ``/root/reference/sudoku.py:48-94``): row/box
+        checks are chip-local on valid units, column checks come from the
+        ring-reduced aggregates, verdicts are OR/AND-merged with a psum.
+        """
+        g = self.geom
+        full = jnp.uint32(g.full_mask)
+        single = is_single(states)
+        decided = jnp.where(single, states, jnp.uint32(0))
+        rv = self._row_valid()[:, None]  # [rows_local, 1]
+        bv = self._box_valid()  # [local boxes]
+
+        empty = jnp.any((states == jnp.uint32(0)) & rv, axis=(-1, -2))
+
+        _, rd_twice = once_twice_reduce(decided, -1)  # dup digit in a row
+        dup = jnp.any((rd_twice != 0) & rv[..., 0], axis=-1)
+        unc = jnp.any((or_reduce(states, -1) != full) & rv[..., 0], axis=-1)
+
+        boxes_d = self._to_boxes(decided)
+        _, bd_twice = once_twice_reduce(boxes_d, -1)
+        dup = dup | jnp.any((bd_twice != 0) & bv, axis=-1)
+        unc = unc | jnp.any(
+            (or_reduce(self._to_boxes(states), -1) != full) & bv, axis=-1
+        )
+
+        cd_once, cd_twice = once_twice_reduce(decided, -2)
+        _, cd_twice = ring_once_twice(cd_once, cd_twice, self.axis, self.n_dev)
+        col_or = ring_or(or_reduce(states, -2), self.axis, self.n_dev)
+        col_dup = jnp.any(cd_twice != 0, axis=-1)
+        col_unc = jnp.any(col_or != full, axis=-1)
+
+        contradiction = _psum_any(empty | dup | unc, self.axis) | col_dup | col_unc
+        undecided = jnp.any(~single & rv, axis=(-1, -2))
+        solved = ~_psum_any(undecided, self.axis) & ~contradiction
+        return solved, contradiction
+
+    # -- branching --------------------------------------------------------
+
+    def branch(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Lowest-digit-vs-rest split of the globally chosen cell.
+
+        Every chip computes its best local (key, global cell) packed scalar,
+        a ``pmin`` picks the global winner, and only the owning chip's shard
+        actually changes.  The key reproduces ``SudokuCSP`` branch order:
+        MRV (or first-undecided) with global row-major cell index tiebreak.
+        """
+        g = self.geom
+        n_lanes = states.shape[0]
+        chip = jax.lax.axis_index(self.axis).astype(jnp.int32)
+        pc = popcount(states).astype(jnp.int32)  # [L, rows_local, n]
+        cell0 = chip * self.rows_local * g.n
+        gcell = cell0 + jnp.arange(self.rows_local * g.n, dtype=jnp.int32).reshape(
+            self.rows_local, g.n
+        )
+        n_cells = self.rows_padded * g.n
+        big = jnp.int32(2**30)
+        undecided = pc > 1  # pad rows have pc == 0, never chosen
+        if self.branch_rule == "minrem":
+            key = jnp.where(undecided, pc * n_cells + gcell, big)
+        else:  # 'first': reference's find_next_empty row-major order
+            key = jnp.where(undecided, gcell, big)
+        local_min = jnp.min(key.reshape(n_lanes, -1), axis=-1)
+        gmin = jax.lax.pmin(local_min, self.axis)  # [L]
+        chosen = gmin % jnp.int32(n_cells)
+        onehot = (gcell[None] == chosen[:, None, None]) & (gmin[:, None, None] < big)
+
+        low = lowest_bit(states)
+        guess = jnp.where(onehot, low, states)
+        rest = jnp.where(onehot, states & ~low, states)
+        return guess, rest
+
+    def signature(self) -> str:
+        return (
+            f"banded-sudoku:{self.geom.box_h}x{self.geom.box_w}"
+            f":{self.n_dev}x{self.bands_per_chip}:{self.branch_rule}:{self.max_sweeps}"
+        )
+
+
+# --------------------------------------------------------------------------
+# Driver: the generic engine under shard_map with row-sharded board tensors.
+# --------------------------------------------------------------------------
+
+
+def _banded_problem(
+    geom: Geometry, config: SolverConfig, n_dev: int, axis: str
+) -> BandedSudoku:
+    bands_per_chip = -(-geom.n_vboxes // n_dev)
+    return BandedSudoku(
+        geom=geom,
+        axis=axis,
+        n_dev=n_dev,
+        bands_per_chip=bands_per_chip,
+        branch_rule=config.branch,
+        max_sweeps=config.max_sweeps,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config", "mesh"))
+def _solve_banded_jit(
+    grids: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
+) -> SolveResult:
+    (axis,) = mesh.axis_names
+    n_dev = mesh.devices.size
+    problem = _banded_problem(geom, config, n_dev, axis)
+
+    cand = encode_grid(grids, geom)  # [J, n, n]
+    pad = problem.rows_padded - geom.n
+    cand = jnp.pad(cand, ((0, 0), (0, pad), (0, 0)))  # pad rows: empty mask 0
+
+    state = init_frontier(cand, config)
+    board = P(None, None, axis, None)  # stack[L, S, rows, n]: rows sharded
+    specs = Frontier(
+        stack=board,
+        sp=P(),
+        job=P(),
+        solved=P(),
+        solution=P(None, axis, None),
+        overflowed=P(),
+        nodes=P(),
+        steps=P(),
+        sweeps=P(),
+        expansions=P(),
+        steals=P(),
+    )
+    body = jax.shard_map(
+        functools.partial(run_frontier, problem=problem, config=config),
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=specs,
+        check_vma=False,
+    )
+    state = body(state)
+
+    res = finalize_frontier(state)  # lane/job bookkeeping: replicated, global
+    sol = res.solution[:, : geom.n, :]  # strip pad rows
+    solution = jnp.where(res.solved[:, None, None], decode_grid(sol), jnp.int32(0))
+    return res._replace(solution=solution)
+
+
+def solve_batch_banded(
+    grids,
+    geom: Geometry,
+    config: SolverConfig = SolverConfig(),
+    mesh: Mesh | None = None,
+) -> SolveResult:
+    """Solve int grids [J, n, n] with each board's rows sharded over ``mesh``.
+
+    The board-parallel counterpart of
+    :func:`~distributed_sudoku_solver_tpu.parallel.sharded.solve_batch_sharded`:
+    use that one to scale over many jobs/lanes, this one when a single board
+    is the thing that must span chips (giant geometries).  Results are
+    bit-identical to the single-device ``solve_batch``.
+    """
+    mesh = mesh if mesh is not None else make_band_mesh()
+    return _solve_banded_jit(jnp.asarray(grids), geom, config, mesh)

@@ -1,0 +1,126 @@
+"""Board-sharded (band-parallel) solve: SURVEY.md §5.7's ring-exchange axis.
+
+Each board's rows are sharded over the mesh; column-unit aggregates travel
+around a ``ppermute`` ring each sweep.  The contract under test: results are
+*bit-identical* to the single-device engine — same solutions, same node
+counts, same branch order — because the collectives are exact all-reduces.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9, SUDOKU_16, SUDOKU_25
+from distributed_sudoku_solver_tpu.ops.bitmask import once_twice_reduce, or_reduce
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+from distributed_sudoku_solver_tpu.parallel.board_sharded import (
+    make_band_mesh,
+    ring_once_twice,
+    ring_or,
+    solve_batch_banded,
+)
+from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, make_puzzle
+
+
+def _band_mesh(n: int) -> Mesh:
+    return make_band_mesh(jax.devices()[:n])
+
+
+def _assert_matches_single_device(grids, geom, cfg, mesh):
+    ref = solve_batch(grids, geom, cfg)
+    res = solve_batch_banded(grids, geom, cfg, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(res.solved), np.asarray(ref.solved))
+    np.testing.assert_array_equal(np.asarray(res.solution), np.asarray(ref.solution))
+    np.testing.assert_array_equal(np.asarray(res.nodes), np.asarray(ref.nodes))
+    np.testing.assert_array_equal(np.asarray(res.unsat), np.asarray(ref.unsat))
+    return res
+
+
+def test_ring_reduces_match_global():
+    """ring_or / ring_once_twice == the one-chip reduction of the full array."""
+    n_dev = 4
+    mesh = _band_mesh(n_dev)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 2**25, size=(n_dev * 3, 16), dtype=np.uint32)
+
+    def local(xs, axis):
+        o, t = once_twice_reduce(xs, 0)
+        return ring_or(or_reduce(xs, 0), axis, n_dev), *ring_once_twice(
+            o, t, axis, n_dev
+        )
+
+    got = jax.jit(
+        jax.shard_map(
+            functools.partial(local, axis=mesh.axis_names[0]),
+            mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec(mesh.axis_names[0]),
+            out_specs=jax.sharding.PartitionSpec(None),
+            check_vma=False,
+        )
+    )(jnp.asarray(x))
+    want_or = or_reduce(jnp.asarray(x), 0)
+    want_o, want_t = once_twice_reduce(jnp.asarray(x), 0)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want_or))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want_o))
+    np.testing.assert_array_equal(np.asarray(got[2]), np.asarray(want_t))
+
+
+def test_9x9_exact_band_fit_bit_exact():
+    """3 chips x 1 band: hard boards (real branching) match single-device."""
+    grids = np.stack(HARD_9[:2]).astype(np.int32)
+    cfg = SolverConfig(min_lanes=8, stack_slots=32, max_steps=4096)
+    res = _assert_matches_single_device(grids, SUDOKU_9, cfg, _band_mesh(3))
+    assert np.asarray(res.solved).all()
+    assert int(np.asarray(res.nodes).sum()) > 0  # branching actually happened
+
+
+def test_9x9_padded_bands_bit_exact():
+    """8 chips over 3 bands: 5 chips hold only pad rows, still bit-exact."""
+    grids = np.stack(HARD_9[:2]).astype(np.int32)
+    cfg = SolverConfig(min_lanes=8, stack_slots=32, max_steps=4096)
+    res = _assert_matches_single_device(grids, SUDOKU_9, cfg, _band_mesh(8))
+    assert np.asarray(res.solved).all()
+
+
+def test_16x16_banded():
+    puzzles = np.stack(
+        [make_puzzle(SUDOKU_16, seed=s, n_clues=170, unique=False) for s in (0, 1)]
+    )
+    cfg = SolverConfig(min_lanes=8, stack_slots=64, max_steps=20_000)
+    res = solve_batch_banded(puzzles, SUDOKU_16, cfg, mesh=_band_mesh(4))
+    assert np.asarray(res.solved).all()
+    for j in range(puzzles.shape[0]):
+        sol = np.asarray(res.solution[j])
+        assert is_valid_solution(sol, SUDOKU_16)
+        mask = puzzles[j] != 0
+        assert np.array_equal(sol[mask], puzzles[j][mask])
+
+
+def test_25x25_banded_bit_exact():
+    """The giant-board config the reference's wire cap breaks on
+    (``/root/reference/DHT_Node.py:94``, SURVEY.md §2.5 #8): one board's
+    25 rows = 5 box bands over 5 chips."""
+    puzzle = make_puzzle(SUDOKU_25, seed=3, n_clues=480, unique=False)
+    cfg = SolverConfig(min_lanes=4, stack_slots=48, max_steps=50_000)
+    res = _assert_matches_single_device(puzzle[None], SUDOKU_25, cfg, _band_mesh(5))
+    assert bool(res.solved[0])
+    assert is_valid_solution(np.asarray(res.solution[0]), SUDOKU_25)
+
+
+def test_banded_unsat_detected():
+    """A row-duplicate contradiction is proven unsat across shards."""
+    puzzle = np.stack(HARD_9[:1]).astype(np.int32)[0]
+    r, c = np.argwhere(puzzle == 0)[0]
+    row_digits = set(puzzle[r][puzzle[r] > 0])
+    puzzle = puzzle.copy()
+    puzzle[r, c] = next(iter(row_digits))
+    cfg = SolverConfig(min_lanes=8, stack_slots=32, max_steps=4096)
+    res = solve_batch_banded(puzzle[None], SUDOKU_9, cfg, mesh=_band_mesh(3))
+    assert not bool(res.solved[0])
+    assert bool(res.unsat[0])
