@@ -20,6 +20,8 @@ BASELINE_BOARDS_PER_S = 1.0 / 3.13  # reference: easy 9x9 end-to-end (BASELINE.m
 
 
 def main() -> None:
+    import os
+
     import jax
 
     from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
@@ -27,6 +29,10 @@ def main() -> None:
     from distributed_sudoku_solver_tpu.ops.solve import solve_batch
     from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, puzzle_batch
 
+    os.environ.setdefault(
+        "DSST_PUZZLE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache", "puzzles"),
+    )
     batch = 512
     gen = puzzle_batch(SUDOKU_9, batch - len(HARD_9), seed=7, n_clues=24)
     grids = np.concatenate([np.stack(HARD_9), gen]).astype(np.int32)

@@ -32,16 +32,27 @@ class SudokuCSP:
     geom: Geometry
     branch_rule: str = "minrem"
     max_sweeps: int = 64
+    propagator: str = "xla"
 
     def __post_init__(self) -> None:
         if self.branch_rule not in ("minrem", "first"):
             raise ValueError(f"unknown branch rule {self.branch_rule!r}")
+        if self.propagator not in ("xla", "pallas"):
+            raise ValueError(f"unknown propagator {self.propagator!r}")
 
     @property
     def state_shape(self) -> tuple[int, int]:
         return (self.geom.n, self.geom.n)
 
     def propagate(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        if self.propagator == "pallas":
+            # VMEM-resident fixpoint kernel; bit-identical to the XLA path
+            # (tests/test_pallas.py pins this).
+            from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+                propagate_fixpoint_pallas,
+            )
+
+            return propagate_fixpoint_pallas(states, self.geom, self.max_sweeps)
         return propagate(states, self.geom, self.max_sweeps)
 
     def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -79,5 +90,5 @@ class SudokuCSP:
     def signature(self) -> str:
         return (
             f"sudoku:{self.geom.box_h}x{self.geom.box_w}"
-            f":{self.branch_rule}:{self.max_sweeps}"
+            f":{self.branch_rule}:{self.max_sweeps}:{self.propagator}"
         )

@@ -71,10 +71,24 @@ def decode_grid(cand: jax.Array) -> jax.Array:
 
 
 def or_reduce(x: jax.Array, axis: int) -> jax.Array:
-    """Bitwise-OR reduction along one axis (the 'digits seen in this unit' op)."""
-    return jax.lax.reduce(
-        x, jnp.uint32(0), lambda a, b: jax.lax.bitwise_or(a, b), (axis % x.ndim,)
-    )
+    """Bitwise-OR reduction along one axis (the 'digits seen in this unit' op).
+
+    Log-depth tree of static slices + ``|`` rather than ``jax.lax.reduce``
+    with a custom combiner: the same primitive-free shape works everywhere —
+    XLA fuses it identically, and it lowers cleanly inside Pallas/Mosaic
+    kernels (``ops/pallas_propagate.py``) where custom reduce combiners don't.
+    """
+    axis = axis % x.ndim
+    x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
+    pow2 = 1 << (n - 1).bit_length()
+    if pow2 != n:
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, pow2 - n)]
+        x = jnp.pad(x, pad)
+    while x.shape[-1] > 1:
+        h = x.shape[-1] // 2
+        x = x[..., :h] | x[..., h:]
+    return x[..., 0]
 
 
 def once_twice_reduce(x: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:

@@ -57,6 +57,8 @@ class SolverConfig:
     max_steps: int = 100_000  # branch rounds before giving up
     max_sweeps: int = 64  # propagation sweeps per fixpoint (Sudoku adapter)
     branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref order)
+    propagator: str = "xla"  # 'xla' | 'pallas' (VMEM kernel; batch solves only
+    #   — the board-sharded path has its own collective sweep and rejects it)
     steal: bool = True  # receiver-initiated work stealing between lanes
     ring_steal_k: int = 8  # max boards shipped per step per chip pair (sharded)
 

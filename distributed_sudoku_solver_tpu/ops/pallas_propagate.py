@@ -1,0 +1,256 @@
+"""Pallas TPU kernel: constraint propagation to a fixpoint, resident in VMEM.
+
+The hot op of the whole framework is ``ops.propagate.propagate`` — the
+elimination + hidden-singles fixpoint that replaces the reference's per-guess
+``is_valid`` scan (``/root/reference/utils.py:27-55``).  The XLA path runs it
+as a ``lax.while_loop`` whose every sweep is a separate pass over the batch
+tensor with a batch-global convergence check between sweeps.
+
+This kernel moves the whole fixpoint on-chip:
+
+* the batch is tiled over a 1-D grid; each program DMAs its ``[tile, n, n]``
+  block of candidate bitmasks into **VMEM** once,
+* the full sweep loop runs against that VMEM block,
+* convergence is *per-tile*: a tile of easy boards stops after 2-3 sweeps
+  instead of every board paying for the slowest board in the whole batch,
+* only the fixpoint is written back — one HBM round-trip instead of one per
+  sweep.
+
+Mosaic (the Pallas TPU compiler) rejects the lane/sublane-mixing reshapes the
+XLA path uses for its box-unit view (``ops.bitmask.to_boxes``), strided
+sublane slices, and unsigned-integer ``sum`` reductions — all verified
+empirically on TPU v5.  The sweep here is therefore re-derived from scratch
+on Mosaic's supported set: static unit-width slices, ``concat``, bitwise ops,
+``population_count``, and balanced fold trees.  The boolean algebra is
+identical (OR / once-twice reductions are associative and exact), so the
+kernel is bit-identical to ``ops.propagate.propagate_sweep`` — pinned by
+``tests/test_pallas.py`` on random and corpus boards.
+
+Used by ``models/sudoku.py`` when ``SudokuCSP.propagator == 'pallas'``
+(plumbed from ``SolverConfig.propagator``).  On non-TPU backends the kernel
+runs in Pallas interpreter mode, so the test suite exercises the same kernel
+code path on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import operator
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+
+try:  # pltpu imports on all jaxlib builds we target; guard for exotic ones
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover - non-TPU jaxlib
+    pltpu = None
+    _VMEM = _SMEM = None
+
+
+# --------------------------------------------------------------------------
+# Mosaic-friendly unit reductions: static slices + balanced folds only.
+# --------------------------------------------------------------------------
+
+
+def _fold(vals: list, comb):
+    """Balanced fold tree (log depth, association-order-independent math)."""
+    while len(vals) > 1:
+        nxt = [comb(vals[i], vals[i + 1]) for i in range(0, len(vals) - 1, 2)]
+        if len(vals) % 2:
+            nxt.append(vals[-1])
+        vals = nxt
+    return vals[0]
+
+
+def _slice1(x, axis: int, i: int):
+    """Width-1 static slice along ``axis``; maps over (once, twice) pairs."""
+
+    def f(v: jax.Array) -> jax.Array:
+        idx = [slice(None)] * v.ndim
+        idx[axis] = slice(i, i + 1)
+        return v[tuple(idx)]
+
+    return jax.tree.map(f, x)
+
+
+def _axis_len(x, axis: int) -> int:
+    return jax.tree.leaves(x)[0].shape[axis]
+
+
+def _concat(parts: list, axis: int):
+    if len(parts) == 1:
+        return parts[0]
+    return jax.tree.map(lambda *vs: jnp.concatenate(vs, axis=axis), *parts)
+
+
+def _group_reduce(x, axis: int, group: int, comb):
+    """Reduce contiguous groups of ``group`` elements along ``axis`` to size 1
+    each; result keeps the axis with length ``n // group``."""
+    n = _axis_len(x, axis)
+    groups = [
+        _fold([_slice1(x, axis, g * group + k) for k in range(group)], comb)
+        for g in range(n // group)
+    ]
+    return _concat(groups, axis)
+
+
+def _expand(x, axis: int, times: int):
+    """Repeat each element ``times`` times along ``axis`` (inverse of
+    ``_group_reduce``'s shape), built from slices + concat only."""
+    parts = [
+        _slice1(x, axis, i) for i in range(_axis_len(x, axis)) for _ in range(times)
+    ]
+    return _concat(parts, axis)
+
+
+_OR = operator.or_
+
+
+def _ot_comb(a, b):
+    """(once, twice) pair semiring: bits seen >=1 / >=2 times."""
+    return a[0] | b[0], a[1] | b[1] | (a[0] & b[0])
+
+
+def _ot_lift(x):
+    return x, jnp.zeros_like(x)
+
+
+def _unit_maps(x: jax.Array, geom: Geometry, comb, lift, row_ax: int, col_ax: int):
+    """Per-cell unit reduction for rows / cols / boxes, broadcast back to
+    ``x.shape``.  Yields one reduced value per unit type, in the same order
+    as ``ops.propagate._unit_views``.  ``row_ax``/``col_ax`` name the board
+    axes, so the same code serves both the XLA layout ``[..., n, n]`` and the
+    kernel's boards-last layout ``[n, n, T]``."""
+    n, bh, bw = geom.n, geom.box_h, geom.box_w
+    # rows: reduce the lane axis
+    row = _group_reduce(lift(x), col_ax, n, comb)
+    yield jax.tree.map(lambda v: jnp.broadcast_to(v, x.shape), row)
+    # cols: reduce the sublane axis
+    col = _group_reduce(lift(x), row_ax, n, comb)
+    yield jax.tree.map(lambda v: jnp.broadcast_to(v, x.shape), col)
+    # boxes: two-stage group reduce, then expand both axes back
+    q = _group_reduce(_group_reduce(lift(x), row_ax, bh, comb), col_ax, bw, comb)
+    yield jax.tree.map(lambda v: _expand(_expand(v, row_ax, bh), col_ax, bw), q)
+
+
+def sweep_mosaic(
+    cand: jax.Array,
+    geom: Geometry,
+    row_ax: int | None = None,
+    col_ax: int | None = None,
+) -> jax.Array:
+    """One propagation sweep, bit-identical to ``propagate_sweep`` but built
+    exclusively from Mosaic-supported ops (see module docstring).
+
+    The board axes default to the last two (the XLA layout); the kernel calls
+    it with ``row_ax=0, col_ax=1`` on boards-last ``[n, n, T]`` tiles so the
+    batch rides the 128-wide lane axis — with boards in the *leading* dims
+    Mosaic unrolls one op per board and compilation explodes (observed: a
+    ``[256, 9, 9]`` tile takes >6 min to compile; ``[9, 9, 256]`` is sub-s).
+    """
+    if row_ax is None:
+        row_ax, col_ax = cand.ndim - 2, cand.ndim - 1
+    single = jax.lax.population_count(cand) == 1
+    decided = jnp.where(single, cand, jnp.uint32(0))
+
+    seen = _fold(
+        list(_unit_maps(decided, geom, _OR, lambda v: v, row_ax, col_ax)), _OR
+    )
+    cand = jnp.where(single, cand, cand & ~seen)
+
+    forced = jnp.zeros_like(cand)
+    for once, twice in _unit_maps(cand, geom, _ot_comb, _ot_lift, row_ax, col_ax):
+        forced = forced | (cand & (once & ~twice))
+    cand = jnp.where(~single & (forced != 0), forced, cand)
+    return cand
+
+
+# --------------------------------------------------------------------------
+# The fixpoint kernel.
+# --------------------------------------------------------------------------
+
+
+def _fixpoint_kernel(cand_ref, out_ref, sweeps_ref, *, geom: Geometry, max_sweeps: int):
+    """One grid program: sweep its VMEM-resident tile of boards to a fixpoint.
+
+    The tile is boards-last ``[n, n, tile]`` — see :func:`sweep_mosaic`.
+    """
+
+    def cond(state):
+        _, changed, sweeps = state
+        return changed & (sweeps < max_sweeps)
+
+    def body(state):
+        cur, _, sweeps = state
+        nxt = sweep_mosaic(cur, geom, row_ax=0, col_ax=1)
+        return nxt, jnp.any(nxt != cur), sweeps + 1
+
+    cand, _, sweeps = jax.lax.while_loop(
+        cond, body, (cand_ref[...], jnp.bool_(True), jnp.int32(0))
+    )
+    out_ref[...] = cand
+    # The sweep-count buffer is unblocked (every program sees the whole
+    # [n_tiles, 1] SMEM array — TPU grids run sequentially) because Mosaic
+    # only allows (1, 1) blocks when they equal the full array shape.
+    sweeps_ref[pl.program_id(0), 0] = sweeps
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "max_sweeps", "tile", "interpret"))
+def propagate_fixpoint_pallas(
+    cand: jax.Array,
+    geom: Geometry,
+    max_sweeps: int = 64,
+    tile: int = 256,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Drop-in for :func:`ops.propagate.propagate` on a ``[B, n, n]`` batch.
+
+    Returns ``(cand_fixpoint, n_sweeps)`` where ``n_sweeps`` is the max sweep
+    count over tiles — the same "rounds until the whole batch stabilized"
+    meaning as the XLA path's loop counter.
+    """
+    if cand.ndim != 3:
+        raise ValueError(f"expected [B, n, n], got {cand.shape}")
+    b, n, _ = cand.shape
+    interp = _interpret_default() if interpret is None else interpret
+
+    tile = min(tile, b)
+    pad = (-b) % tile
+    if pad:
+        # Zero boards (no candidates anywhere) are already at fixpoint, so
+        # padding never inflates a tile's sweep count.
+        cand = jnp.concatenate([cand, jnp.zeros((pad, n, n), cand.dtype)], axis=0)
+    n_tiles = cand.shape[0] // tile
+
+    # Boards-last for the kernel: the batch rides the 128-wide lane axis
+    # (see sweep_mosaic on why boards-first is catastrophic for Mosaic).
+    cand_t = jnp.transpose(cand, (1, 2, 0))
+
+    kernel = functools.partial(_fixpoint_kernel, geom=geom, max_sweeps=max_sweeps)
+    vmem = dict(memory_space=_VMEM) if (_VMEM is not None and not interp) else {}
+    smem = dict(memory_space=_SMEM) if (_SMEM is not None and not interp) else {}
+    out_t, sweeps = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((n, n, tile), lambda i: (0, 0, i), **vmem)],
+        out_specs=(
+            pl.BlockSpec((n, n, tile), lambda i: (0, 0, i), **vmem),
+            pl.BlockSpec(**smem),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(cand_t.shape, cand.dtype),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+        ),
+        interpret=interp,
+    )(cand_t)
+    return jnp.transpose(out_t, (2, 0, 1))[:b], jnp.max(sweeps)

@@ -80,7 +80,10 @@ def _finalize(state: Frontier) -> SolveResult:
 def sudoku_csp(geom: Geometry, config: SolverConfig) -> SudokuCSP:
     """The Sudoku problem a (geom, config) pair denotes — one place, everywhere."""
     return SudokuCSP(
-        geom=geom, branch_rule=config.branch, max_sweeps=config.max_sweeps
+        geom=geom,
+        branch_rule=config.branch,
+        max_sweeps=config.max_sweeps,
+        propagator=config.propagator,
     )
 
 

@@ -337,6 +337,14 @@ class BandedSudoku:
 def _banded_problem(
     geom: Geometry, config: SolverConfig, n_dev: int, axis: str
 ) -> BandedSudoku:
+    if config.propagator != "xla":
+        # The banded sweep has its own ring-exchange collectives; the Pallas
+        # batch kernel does not apply here.  Fail loudly rather than let the
+        # option silently not take effect.
+        raise ValueError(
+            f"board-sharded solve supports propagator='xla' only, "
+            f"got {config.propagator!r}"
+        )
     bands_per_chip = -(-geom.n_vboxes // n_dev)
     return BandedSudoku(
         geom=geom,

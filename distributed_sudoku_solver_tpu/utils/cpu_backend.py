@@ -30,6 +30,14 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
         import jax
         import jax._src.xla_bridge as _xb
 
+        # Import every module that registers per-platform MLIR lowering rules
+        # *before* evicting backend factories: once the 'tpu' factory is
+        # popped the platform name is unknown, and a later first import of
+        # e.g. pallas/checkify would raise NotImplementedError registering
+        # its tpu rules.
+        import jax._src.checkify  # noqa: F401
+        from jax.experimental import pallas  # noqa: F401
+
         # sitecustomize may have imported jax already (capturing the outer
         # env), so update the live config, not just the env var, and drop
         # every non-CPU backend factory.

@@ -10,12 +10,31 @@ truncates 25x25 tasks, ``/root/reference/DHT_Node.py:94``, SURVEY.md §2.5 #8).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
-from distributed_sudoku_solver_tpu.utils.oracle import count_solutions
+from distributed_sudoku_solver_tpu.utils.oracle import count_solutions as _py_count
+
+
+# Bump when random_solution/make_puzzle output could change for a given seed:
+# it keys the on-disk batch cache, so stale boards are never served.
+_GENERATOR_VERSION = 1
+
+
+def _count_solutions_fast(grid, geom: Optional[Geometry] = None, limit: int = 2) -> int:
+    """Uniqueness probe; prefers the native C++ oracle (~1000x the Python
+    one — carving a puzzle runs dozens of these, so generation time is
+    entirely this call).  Distinct from ``utils.oracle.count_solutions``,
+    which stays pure Python on purpose: it is the independent authority the
+    native library itself is tested against (tests/test_native.py)."""
+    from distributed_sudoku_solver_tpu import native
+
+    if native.available():
+        return native.count_solutions(grid, geom, limit=limit)
+    return _py_count(grid, geom, limit=limit)
 
 
 def parse_line(line: str, n: int = 9) -> np.ndarray:
@@ -116,7 +135,7 @@ def make_puzzle(
         puzzle[r, c] = 0
         if unique:
             probes += 1
-            if count_solutions(puzzle, geom, limit=2) != 1:
+            if _count_solutions_fast(puzzle, geom, limit=2) != 1:
                 puzzle[r, c] = saved
                 continue
         remaining -= 1
@@ -129,8 +148,31 @@ def puzzle_batch(
     seed: int = 0,
     n_clues: Optional[int] = None,
     unique: bool = True,
+    cache_dir: Optional[str] = None,
 ) -> np.ndarray:
-    """Stack ``count`` generated puzzles into int64[count, n, n]."""
-    return np.stack(
+    """Stack ``count`` generated puzzles into int64[count, n, n].
+
+    With ``cache_dir`` (or env ``DSST_PUZZLE_CACHE``), the batch is memoized
+    on disk keyed by every generation parameter — benchmarks regenerate
+    nothing across runs.  Generation is deterministic, so the cache changes
+    results never, only latency.
+    """
+    cache_dir = cache_dir or os.environ.get("DSST_PUZZLE_CACHE")
+    path = None
+    if cache_dir:
+        key = (
+            f"v{_GENERATOR_VERSION}_{geom.box_h}x{geom.box_w}"
+            f"_{count}_{seed}_{n_clues}_{int(unique)}"
+        )
+        path = os.path.join(cache_dir, f"puzzles_{key}.npy")
+        if os.path.exists(path):
+            return np.load(path)
+    batch = np.stack(
         [make_puzzle(geom, seed + i, n_clues=n_clues, unique=unique) for i in range(count)]
     )
+    if path:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        np.save(tmp, batch)
+        os.replace(tmp, path)
+    return batch
