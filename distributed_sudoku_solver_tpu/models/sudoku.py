@@ -37,7 +37,7 @@ class SudokuCSP:
     def __post_init__(self) -> None:
         if self.branch_rule not in ("minrem", "first"):
             raise ValueError(f"unknown branch rule {self.branch_rule!r}")
-        if self.propagator not in ("xla", "pallas"):
+        if self.propagator not in ("xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
 
     @property
@@ -45,14 +45,23 @@ class SudokuCSP:
         return (self.geom.n, self.geom.n)
 
     def propagate(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        # All three backends are bit-identical (tests/test_pallas.py pins it);
+        # they differ in layout/residency: 'pallas' = VMEM-tile kernel (bulk
+        # batches), 'slices' = boards-last XLA (large lane counts inside the
+        # frontier loop), 'xla' = boards-first XLA (small lane counts, where
+        # the whole loop state lives in VMEM anyway).
         if self.propagator == "pallas":
-            # VMEM-resident fixpoint kernel; bit-identical to the XLA path
-            # (tests/test_pallas.py pins this).
             from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
                 propagate_fixpoint_pallas,
             )
 
             return propagate_fixpoint_pallas(states, self.geom, self.max_sweeps)
+        if self.propagator == "slices":
+            from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+                propagate_fixpoint_slices,
+            )
+
+            return propagate_fixpoint_slices(states, self.geom, self.max_sweeps)
         return propagate(states, self.geom, self.max_sweeps)
 
     def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:

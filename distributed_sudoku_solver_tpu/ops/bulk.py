@@ -1,0 +1,201 @@
+"""Bulk solver: propagation-first pipeline for very large batches (DP at scale).
+
+The throughput-oriented entry point — the workload the reference could only
+express as one HTTP `POST /solve` per puzzle per ring (SURVEY.md §2.2 "Data
+parallelism: NO — one puzzle at a time") becomes one call on a ``[B, n, n]``
+batch with B in the 10^5-10^6 range:
+
+* **Stage 1 — propagate**: the whole batch runs the elimination +
+  hidden-singles fixpoint once.  On TPU this is the Pallas VMEM kernel
+  (``ops/pallas_propagate.py``), which is HBM-bandwidth-bound — each board
+  is read once and written once no matter how many sweeps it needs.  Most
+  easy/medium boards (e.g. the classic Kaggle 1M corpus) finish here with
+  zero search.
+* **Stage 2 — search the survivors**: boards still undecided are compacted
+  (host side — survivor counts are data-dependent, and XLA wants static
+  shapes) and fed through the lane-stack frontier engine
+  (``ops/frontier.py``) in VMEM-sized chunks.  JAX's async dispatch
+  pipelines chunk k+1's transfer against chunk k's compute.
+
+Contradictions found in stage 1 are reported as unsat without ever touching
+the search engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid, encode_grid
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.propagate import board_status
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+
+@dataclasses.dataclass(frozen=True)
+class BulkConfig:
+    """Static bulk-pipeline configuration.
+
+    Stage-2 defaults come from a TPU v5e sweep (this session): survivor
+    throughput scales with chunk width up to ~32k lanes at 1 job/lane
+    (1.0k boards/s at 512 lanes -> 41.8k at 32768), so the first rung is
+    wide and shallow; deeper rungs re-run the rare stragglers that
+    overflow a shallow stack or hit the step cap.
+    """
+
+    chunk: int = 65536  # stage-1 dispatch granularity (boards)
+    search_lanes: int = 32768  # rung-1 frontier width (jobs = lanes)
+    stack_slots: int = 16  # rung-1 DFS depth
+    max_steps: int = 100_000
+    max_sweeps: int = 64
+    propagator: Optional[str] = None  # stage 1; None = auto (pallas on TPU)
+    # Escalation rungs for unresolved boards: (max jobs/chunk, lanes per job,
+    # stack slots).  Wider-than-jobs lanes give straggler jobs an OR-parallel
+    # gang of thief lanes; deep stacks make overflow impossible in practice.
+    rungs: tuple = ((2048, 4, 64), (64, 64, 256))
+
+    def __post_init__(self) -> None:
+        if self.propagator not in (None, "xla", "pallas", "slices"):
+            raise ValueError(f"unknown propagator {self.propagator!r}")
+
+
+@dataclasses.dataclass
+class BulkResult:
+    """Per-board verdicts for one bulk call (host-side numpy)."""
+
+    solution: np.ndarray  # int32[B, n, n]; zeros where unsolved
+    solved: np.ndarray  # bool[B]
+    unsat: np.ndarray  # bool[B]
+    by_propagation: np.ndarray  # bool[B]: solved with zero search
+    searched: int  # boards that went through stage 2
+
+
+def _auto_propagator() -> str:
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _trivial_board(geom: Geometry) -> np.ndarray:
+    """A complete valid board: the zero-work padding job for partial chunks."""
+    from distributed_sudoku_solver_tpu.utils.puzzles import random_solution
+
+    return np.asarray(random_solution(geom, seed=0), dtype=np.int32)
+
+
+def _propagate_stage(cand: jax.Array, geom: Geometry, cfg: BulkConfig):
+    propagator = cfg.propagator or _auto_propagator()
+    if propagator == "pallas":
+        from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+            propagate_fixpoint_pallas,
+        )
+
+        fixed, _ = propagate_fixpoint_pallas(cand, geom, cfg.max_sweeps)
+    elif propagator == "slices":
+        from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+            propagate_fixpoint_slices,
+        )
+
+        fixed, _ = propagate_fixpoint_slices(cand, geom, cfg.max_sweeps)
+    elif propagator == "xla":
+        from distributed_sudoku_solver_tpu.ops.propagate import propagate
+
+        fixed, _ = propagate(cand, geom, cfg.max_sweeps)
+    else:
+        raise ValueError(f"unknown propagator {propagator!r}")
+    return fixed, board_status(fixed, geom)
+
+
+def solve_bulk(
+    grids,
+    geom: Geometry,
+    config: BulkConfig = BulkConfig(),
+) -> BulkResult:
+    """Solve ``grids`` int[B, n, n] (0 = empty); B may be huge.
+
+    Stage-1 chunks stream through the device back to host verdict arrays;
+    survivors are batched through the frontier engine.  Everything is
+    deterministic: results are independent of chunk sizes.
+    """
+    grids = np.ascontiguousarray(np.asarray(grids, dtype=np.int32))
+    b, n, _ = grids.shape
+
+    solution = np.zeros((b, n, n), dtype=np.int32)
+    solved = np.zeros(b, dtype=bool)
+    unsat = np.zeros(b, dtype=bool)
+
+    # --- stage 1: propagate every board to its fixpoint -------------------
+    pending: list[tuple[int, jax.Array, jax.Array, jax.Array]] = []
+    for lo in range(0, b, config.chunk):
+        chunk = jnp.asarray(grids[lo : lo + config.chunk])
+        cand = encode_grid(chunk, geom)
+        fixed, st = _propagate_stage(cand, geom, config)
+        dec = decode_grid(fixed)
+        pending.append((lo, dec, st.solved, st.contradiction))
+    for lo, dec, st_solved, st_contra in pending:
+        dec, st_solved, st_contra = (
+            np.asarray(dec),
+            np.asarray(st_solved),
+            np.asarray(st_contra),
+        )
+        hi = lo + dec.shape[0]
+        solution[lo:hi][st_solved] = dec[st_solved]
+        solved[lo:hi] = st_solved
+        unsat[lo:hi] = st_contra
+    by_propagation = solved.copy()
+
+    # --- stage 2: frontier-search the undecided remainder -----------------
+    survivors = np.flatnonzero(~solved & ~unsat)
+    searched = int(len(survivors))
+    # Frontier propagation backend: boards-last slice sweeps win at wide
+    # lane counts; at the deep rungs' narrow widths the boards-first loop
+    # fuses into VMEM anyway, so 'xla' avoids the transpose round-trips.
+    rungs = [(config.search_lanes, 1, config.stack_slots, "slices")] + [
+        (jobs, mult, slots, "xla") for jobs, mult, slots in config.rungs
+    ]
+    remaining = survivors
+    for max_jobs, lanes_per_job, slots, prop in rungs:
+        if len(remaining) == 0:
+            break
+        # Round the chunk up to a power of two (>= 64) so each rung compiles
+        # O(log) distinct shapes across calls, not one per survivor count.
+        jobs_per_chunk = min(max_jobs, max(64, 1 << (len(remaining) - 1).bit_length()))
+        scfg = SolverConfig(
+            min_lanes=jobs_per_chunk * lanes_per_job,
+            stack_slots=slots,
+            max_steps=config.max_steps,
+            max_sweeps=config.max_sweeps,
+            propagator=prop,
+        )
+        # Pad partial chunks with an already-complete board: its lane solves
+        # on step one and immediately turns thief, joining the OR-parallel
+        # gang on the real jobs (padding with a survivor copy would instead
+        # burn those lanes re-searching the hardest board).
+        pad_board = _trivial_board(geom)
+        still: list[int] = []
+        for lo in range(0, len(remaining), jobs_per_chunk):
+            idx = remaining[lo : lo + jobs_per_chunk]
+            batch = grids[idx]
+            if len(idx) < jobs_per_chunk:  # keep one compiled shape per rung
+                pad = np.tile(pad_board[None], (jobs_per_chunk - len(idx), 1, 1))
+                batch = np.concatenate([batch, pad])
+            res = solve_batch(jnp.asarray(batch), geom, scfg)
+            r_sol = np.asarray(res.solution)[: len(idx)]
+            r_solved = np.asarray(res.solved)[: len(idx)]
+            r_unsat = np.asarray(res.unsat)[: len(idx)]
+            solution[idx] = np.where(r_solved[:, None, None], r_sol, 0)
+            solved[idx] = r_solved
+            unsat[idx] = r_unsat
+            still.extend(idx[~r_solved & ~r_unsat])
+        remaining = np.asarray(still, dtype=survivors.dtype)
+
+    return BulkResult(
+        solution=solution,
+        solved=solved,
+        unsat=unsat,
+        by_propagation=by_propagation,
+        searched=searched,
+    )

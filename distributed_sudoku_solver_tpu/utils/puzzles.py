@@ -172,7 +172,8 @@ def puzzle_batch(
     )
     if path:
         os.makedirs(cache_dir, exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # np.save appends '.npy' unless the name already ends with it.
+        tmp = f"{path}.{os.getpid()}.tmp.npy"
         np.save(tmp, batch)
         os.replace(tmp, path)
     return batch

@@ -1,0 +1,50 @@
+"""Bulk pipeline: verdicts must match the per-batch solver and the oracle."""
+
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution, solve_oracle
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9, puzzle_batch
+
+
+def _corpus(n_gen=12, n_clues=30):
+    gen = puzzle_batch(SUDOKU_9, n_gen, seed=21, n_clues=n_clues)
+    return np.concatenate([np.stack([EASY_9, *HARD_9]), gen]).astype(np.int32)
+
+
+def test_bulk_solves_everything_and_validates():
+    grids = _corpus()
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8, search_lanes=32))
+    assert res.solved.all() and not res.unsat.any()
+    for g, s in zip(grids, res.solution):
+        assert is_valid_solution(s)
+        assert ((g == 0) | (s == g)).all()  # clues preserved
+    # the easy board needs no search; the hard trio does
+    assert res.by_propagation[0]
+    assert res.searched >= 3
+
+
+def test_bulk_chunking_is_invisible():
+    grids = _corpus(n_gen=6)
+    a = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=4, search_lanes=16))
+    b = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=64, search_lanes=64))
+    np.testing.assert_array_equal(a.solution, b.solution)
+    np.testing.assert_array_equal(a.solved, b.solved)
+
+
+def test_bulk_reports_unsat():
+    bad = np.stack([EASY_9, EASY_9]).astype(np.int32)
+    bad[1, 0, 2] = 5  # row already holds a 5 -> contradiction
+    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2, search_lanes=16))
+    assert res.solved[0] and not res.solved[1]
+    assert res.unsat[1]
+    assert solve_oracle(bad[1]) is None
+
+
+def test_bulk_matches_oracle_solution_on_unique_puzzles():
+    grids = puzzle_batch(SUDOKU_9, 4, seed=33, n_clues=28).astype(np.int32)
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=4, search_lanes=16))
+    assert res.solved.all()
+    for g, s in zip(grids, res.solution):
+        np.testing.assert_array_equal(s, solve_oracle(g))
