@@ -18,11 +18,19 @@ import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
 
-_SRC = os.path.join(os.path.dirname(__file__), "src", "solver.cc")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "_libcsp.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+
+
+def _sources() -> list[str]:
+    return sorted(
+        os.path.join(_SRC_DIR, f)
+        for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cc")
+    )
 
 
 def _build() -> bool:
@@ -32,9 +40,10 @@ def _build() -> bool:
         "-march=native",
         "-shared",
         "-fPIC",
+        "-pthread",
         "-o",
         _LIB_PATH,
-        _SRC,
+        *_sources(),
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -51,9 +60,10 @@ def load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        stale = not os.path.exists(_LIB_PATH) or os.path.getmtime(
-            _LIB_PATH
-        ) < os.path.getmtime(_SRC)
+        stale = not os.path.exists(_LIB_PATH) or any(
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+            for src in _sources()
+        )
         if stale and not _build():
             _build_failed = True
             return None
@@ -80,6 +90,17 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p,
         ]
         lib.csp_solve_batch.restype = ctypes.c_int
+        lib.csp_parse_boards.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, i32p,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.csp_parse_boards.restype = ctypes.c_int64
+        lib.csp_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.csp_count_lines.restype = ctypes.c_int64
+        lib.csp_format_boards.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.csp_format_boards.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -148,3 +169,44 @@ def solve_batch(grids, geom: Optional[Geometry] = None):
         nodes.ctypes.data_as(ctypes.c_void_p),
     )
     return g, results, nodes
+
+
+def parse_boards(data: bytes, n: int, max_boards: Optional[int] = None,
+                 allow_header: bool = True, n_threads: int = 0) -> np.ndarray:
+    """Parse newline-separated board lines (first CSV field) -> int32[B, n, n].
+
+    Raises ValueError naming the first malformed line.  Blank/whitespace
+    lines are skipped; with ``allow_header`` an unparseable *first* line is
+    treated as a CSV header, otherwise it is an error (see src/loader.cc).
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable (no compiler?)")
+    upper = int(lib.csp_count_lines(data, len(data)))
+    if max_boards is not None:
+        upper = min(upper, int(max_boards))
+    out = np.empty((max(upper, 1), n, n), dtype=np.int32)
+    got = int(
+        lib.csp_parse_boards(
+            data, len(data), n, out.reshape(-1), upper, int(allow_header), n_threads
+        )
+    )
+    if got < 0:
+        raise ValueError(f"malformed board at data line {-got - 1}")
+    return out[:got]
+
+
+def format_boards(boards) -> bytes:
+    """int[B, n, n] -> newline-separated board lines (inverse of parse)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable (no compiler?)")
+    g = np.ascontiguousarray(np.asarray(boards), dtype=np.int32)
+    if g.ndim != 3 or g.shape[1] != g.shape[2]:
+        raise ValueError(f"expected [B, n, n] boards, got shape {g.shape}")
+    count, n = g.shape[0], g.shape[1]
+    if count == 0:
+        return b""
+    buf = ctypes.create_string_buffer(count * (n * n + 1))
+    written = int(lib.csp_format_boards(g.reshape(-1), count, n, buf))
+    return buf.raw[:written]

@@ -1,0 +1,154 @@
+// Native bulk puzzle loader: newline-separated board strings -> int32 batches.
+//
+// The data-plane feeder for the bulk solver (ops/bulk.py).  The reference has
+// no dataset path at all — each puzzle arrives as one HTTP POST body parsed
+// in Python (/root/reference/DHT_Node.py:546-549); at 10^5-10^6 boards/s of
+// solver throughput, Python-side string parsing (~10^5 boards/s single
+// thread) would be the pipeline bottleneck, so ingestion is native and
+// multithreaded here.
+//
+// Format, per line: the first field (up to ',', for Kaggle-style CSVs) must
+// hold exactly n*n board characters: '.' or '0' = empty, digits then
+// lowercase base-36 letters for values (matches utils/puzzles.py parse_line).
+// Lines not matching are an error, reported by line index; empty lines and a
+// leading header line (detected: first field not n*n board chars) are
+// skipped.
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Board-character value: '.'/'0' -> 0, '1'-'9' -> 1-9, 'a'-'z' -> 10-35
+// (base 36, matching utils/puzzles.py parse_line/to_line); -1 if invalid.
+inline int char_value(char ch) {
+  if (ch == '.' || ch == '0') return 0;
+  if (ch >= '1' && ch <= '9') return ch - '0';
+  if (ch >= 'a' && ch <= 'z') return ch - 'a' + 10;
+  return -1;
+}
+
+struct LineSpan {
+  const char* begin;
+  int64_t len;  // excluding newline
+};
+
+// Parse one line's first field into out[n*n]; returns true on success.
+bool parse_line(const LineSpan& line, int n, int32_t* out) {
+  const int cells = n * n;
+  if (line.len < cells) return false;
+  if (line.len > cells && line.begin[cells] != ',') return false;
+  for (int i = 0; i < cells; ++i) {
+    const int v = char_value(line.begin[i]);
+    if (v < 0 || v > n) return false;
+    out[i] = v;
+  }
+  return true;
+}
+
+inline bool all_space(const char* p, int64_t len) {
+  for (int64_t i = 0; i < len; ++i) {
+    if (!std::isspace(static_cast<unsigned char>(p[i]))) return false;
+  }
+  return true;
+}
+
+void split_lines(const char* buf, int64_t len, std::vector<LineSpan>* lines) {
+  int64_t start = 0;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || buf[i] == '\n') {
+      int64_t end = i;
+      if (end > start && buf[end - 1] == '\r') --end;  // CRLF
+      // Whitespace-only lines count as empty (matches the Python fallback).
+      if (end > start && !all_space(buf + start, end - start)) {
+        lines->push_back({buf + start, end - start});
+      }
+      start = i + 1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse up to `max_boards` boards out of `buf[0:len]`.
+// Returns the number of boards written to `out` (row-major int32 n*n each),
+// or -(line_index+1) on the first malformed line (0-based index into the
+// non-empty lines, after optional header skip).
+// `allow_header` != 0 permits skipping line 0 iff it does not parse as a
+// board (Kaggle-style CSV headers); with 0, every line must parse or the
+// call errors — callers streaming chunk 2+ of a file use this.
+// `n_threads` <= 0 means auto (hardware concurrency).
+int64_t csp_parse_boards(const char* buf, int64_t len, int n, int32_t* out,
+                         int64_t max_boards, int allow_header, int n_threads) {
+  if (n < 1 || n > 35 || len < 0) return -1;
+  std::vector<LineSpan> lines;
+  split_lines(buf, len, &lines);
+  if (lines.empty()) return 0;
+
+  int64_t first = 0;
+  if (allow_header != 0) {
+    std::vector<int32_t> scratch(static_cast<size_t>(n) * n);
+    if (!parse_line(lines[0], n, scratch.data())) first = 1;
+  }
+  const int64_t count =
+      std::min<int64_t>(max_boards, static_cast<int64_t>(lines.size()) - first);
+  if (count <= 0) return 0;
+
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads <= 0) n_threads = hw > 0 ? hw : 4;
+  if (n_threads > count) n_threads = static_cast<int>(count);
+
+  std::vector<int64_t> bad(n_threads, -1);
+  std::vector<std::thread> threads;
+  const int cells = n * n;
+  for (int t = 0; t < n_threads; ++t) {
+    threads.emplace_back([&, t]() {
+      const int64_t lo = count * t / n_threads;
+      const int64_t hi = count * (t + 1) / n_threads;
+      for (int64_t i = lo; i < hi; ++i) {
+        if (!parse_line(lines[first + i], n, out + i * cells)) {
+          bad[t] = i;
+          return;
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  for (int t = 0; t < n_threads; ++t) {
+    if (bad[t] >= 0) return -(bad[t] + 1);
+  }
+  return count;
+}
+
+// Count non-empty (non-whitespace) lines, so the caller can size the output
+// array: an upper bound; exact sizing happens via csp_parse_boards' return.
+int64_t csp_count_lines(const char* buf, int64_t len) {
+  std::vector<LineSpan> lines;
+  split_lines(buf, len, &lines);
+  return static_cast<int64_t>(lines.size());
+}
+
+// Render boards back to text lines (inverse of csp_parse_boards; no commas).
+// Each line is n*n chars + '\n'.  Returns bytes written.
+int64_t csp_format_boards(const int32_t* boards, int64_t count, int n,
+                          char* out) {
+  static const char digits[] = "0123456789abcdefghijklmnopqrstuvwxyz";
+  const int cells = n * n;
+  int64_t pos = 0;
+  for (int64_t b = 0; b < count; ++b) {
+    const int32_t* g = boards + b * cells;
+    for (int i = 0; i < cells; ++i) {
+      const int32_t v = g[i];
+      out[pos++] = (v >= 0 && v <= 35) ? digits[v] : '?';
+    }
+    out[pos++] = '\n';
+  }
+  return pos;
+}
+
+}  // extern "C"

@@ -1,0 +1,167 @@
+"""Bulk puzzle dataset IO: file -> int32 batches -> bulk solver -> file.
+
+The data-loader layer of the framework (the reference has none — every board
+arrives as one hand-POSTed HTTP body, ``/root/reference/DHT_Node.py:546-549``).
+Parsing is delegated to the multithreaded native loader
+(``native/src/loader.cc``) when available, with a pure-Python fallback, and
+batches stream so a million-board file never materializes as Python objects.
+
+File format: one board per line, n*n chars, '.' or '0' = empty, digits then
+lowercase base-36 letters ('a'=10) for larger geometries; Kaggle-style CSVs
+work too (first comma-separated field is the board, header auto-skipped).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.utils.puzzles import parse_line, to_line
+
+
+def _parse_python(data: bytes, n: int, allow_header: bool) -> np.ndarray:
+    boards = []
+    lines = [ln for ln in data.decode().splitlines() if ln.strip()]
+    for i, raw in enumerate(lines):
+        line = raw.split(",")[0].strip()
+        try:
+            boards.append(parse_line(line, n))
+        except ValueError:
+            if i == 0 and allow_header:
+                continue
+            raise ValueError(f"malformed board at data line {len(boards)}")
+    if not boards:
+        return np.zeros((0, n, n), dtype=np.int32)
+    return np.stack(boards).astype(np.int32)
+
+
+def parse_boards(data: bytes, geom: Geometry, allow_header: bool = True) -> np.ndarray:
+    """Board lines -> int32[B, n, n]; native multithreaded parse if possible.
+
+    ``allow_header=False`` forbids the skip-unparseable-first-line heuristic,
+    so a malformed line raises instead of being dropped — used for every
+    chunk after the first when streaming, to keep output line-aligned.
+    """
+    from distributed_sudoku_solver_tpu import native
+
+    if native.available():
+        return native.parse_boards(data, geom.n, allow_header=allow_header)
+    return _parse_python(data, geom.n, allow_header)
+
+
+def load_boards(path: str, geom: Geometry) -> np.ndarray:
+    with open(path, "rb") as f:
+        return parse_boards(f.read(), geom)
+
+
+def iter_board_batches(
+    path: str, geom: Geometry, batch: int = 65536
+) -> Iterator[np.ndarray]:
+    """Stream ``[<=batch, n, n]`` arrays from a board file of any size.
+
+    Reads in ~batch-line byte chunks aligned to line boundaries, so memory
+    stays O(batch) regardless of file size.
+    """
+    # +2 covers a solutions CSV column; a too-small guess only means more
+    # read calls, never wrong results (the remainder carries over).
+    approx_line = 2 * geom.n * geom.n + 2
+    chunk_bytes = batch * approx_line
+    with open(path, "rb") as f:
+        rest = b""
+        first = True
+        n_done = 0
+        while True:
+            blob = f.read(chunk_bytes)
+            if not blob:
+                break
+            data = rest + blob
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                rest = data
+                continue
+            rest, data = data[cut + 1 :], data[: cut + 1]
+            # Only the true file head may hold a header line; later chunks
+            # must parse every line or raise, to stay line-aligned.
+            boards = _parse_chunk(data, geom, allow_header=first, offset=n_done)
+            first = False
+            n_done += len(boards)
+            for lo in range(0, len(boards), batch):
+                yield boards[lo : lo + batch]
+        if rest.strip():
+            boards = _parse_chunk(rest + b"\n", geom, allow_header=first, offset=n_done)
+            for lo in range(0, len(boards), batch):
+                yield boards[lo : lo + batch]
+
+
+def _parse_chunk(data: bytes, geom: Geometry, allow_header: bool, offset: int):
+    """parse_boards, rewriting chunk-relative error indices to file-absolute."""
+    try:
+        return parse_boards(data, geom, allow_header=allow_header)
+    except ValueError as e:
+        import re
+
+        m = re.search(r"data line (\d+)", str(e))
+        if m:
+            raise ValueError(
+                f"malformed board at data line {offset + int(m.group(1))}"
+            ) from None
+        raise
+
+
+def save_boards(path: str, boards) -> None:
+    """int[B, n, n] -> one base-36 line per board (atomic replace)."""
+    g = np.ascontiguousarray(np.asarray(boards), dtype=np.int32)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(_format_lines(g))
+    os.replace(tmp, path)
+
+
+def _format_lines(boards: np.ndarray) -> bytes:
+    from distributed_sudoku_solver_tpu import native
+
+    if native.available():
+        return native.format_boards(boards)
+    return ("\n".join(to_line(b) for b in boards) + "\n").encode() if len(boards) else b""
+
+
+def solve_file(
+    in_path: str,
+    out_path: Optional[str],
+    geom: Geometry,
+    batch: int = 65536,
+    bulk_config=None,
+):
+    """Solve every board in a file; returns aggregate stats.
+
+    With ``out_path``, solutions are written line-aligned with the input
+    (unsolved lines all-zeros), streamed batch-by-batch to a temp file and
+    atomically renamed — peak memory stays O(batch) end to end.
+    """
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+
+    cfg = bulk_config or BulkConfig()
+    total = solved = unsat = searched = 0
+    tmp = f"{out_path}.{os.getpid()}.tmp" if out_path else None
+    out_f = open(tmp, "wb") if tmp else None
+    try:
+        for boards in iter_board_batches(in_path, geom, batch):
+            res = solve_bulk(boards, geom, cfg)
+            total += len(boards)
+            solved += int(res.solved.sum())
+            unsat += int(res.unsat.sum())
+            searched += res.searched
+            if out_f:
+                out_f.write(_format_lines(res.solution))
+        if out_f:
+            out_f.close()
+            out_f = None
+            os.replace(tmp, out_path)
+    finally:
+        if out_f:
+            out_f.close()
+            os.unlink(tmp)
+    return {"total": total, "solved": solved, "unsat": unsat, "searched": searched}

@@ -1,0 +1,116 @@
+"""Dataset IO: native loader vs Python fallback, streaming, file solve."""
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu import native
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig
+from distributed_sudoku_solver_tpu.utils import dataset
+from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+from distributed_sudoku_solver_tpu.utils.puzzles import (
+    EASY_9,
+    HARD_9,
+    puzzle_batch,
+    to_line,
+)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    gen = puzzle_batch(SUDOKU_9, 10, seed=41, n_clues=30)
+    return np.concatenate([np.stack([EASY_9, *HARD_9]), gen]).astype(np.int32)
+
+
+def test_parse_roundtrip(corpus):
+    blob = ("\n".join(to_line(b) for b in corpus) + "\n").encode()
+    got = dataset.parse_boards(blob, SUDOKU_9)
+    np.testing.assert_array_equal(got, corpus)
+
+
+def test_parse_python_and_native_agree(corpus):
+    blob = ("\n".join(to_line(b) for b in corpus) + "\n").encode()
+    py = dataset._parse_python(blob, 9, allow_header=True)
+    np.testing.assert_array_equal(py, corpus)
+    if native.available():
+        np.testing.assert_array_equal(native.parse_boards(blob, 9), corpus)
+
+
+def test_parse_kaggle_csv_with_header(corpus):
+    rows = [f"{to_line(b)},{to_line(b)}" for b in corpus]
+    blob = ("quizzes,solutions\n" + "\n".join(rows) + "\n").encode()
+    got = dataset.parse_boards(blob, SUDOKU_9)
+    np.testing.assert_array_equal(got, corpus)
+
+
+def test_parse_dot_notation():
+    line = to_line(EASY_9).replace("0", ".")
+    got = dataset.parse_boards((line + "\n").encode(), SUDOKU_9)
+    np.testing.assert_array_equal(got[0], EASY_9)
+
+
+def test_malformed_line_raises(corpus):
+    blob = (to_line(corpus[0]) + "\nnot-a-board\n").encode()
+    with pytest.raises(ValueError):
+        dataset.parse_boards(blob, SUDOKU_9, allow_header=False)
+
+
+def test_save_load_roundtrip(tmp_path, corpus):
+    path = str(tmp_path / "boards.txt")
+    dataset.save_boards(path, corpus)
+    np.testing.assert_array_equal(dataset.load_boards(path, SUDOKU_9), corpus)
+
+
+def test_iter_batches_streams_everything(tmp_path, corpus):
+    big = np.tile(corpus, (20, 1, 1))
+    path = str(tmp_path / "big.txt")
+    dataset.save_boards(path, big)
+    got = np.concatenate(list(dataset.iter_board_batches(path, SUDOKU_9, batch=64)))
+    np.testing.assert_array_equal(got, big)
+
+
+def test_solve_file_end_to_end(tmp_path, corpus):
+    in_path = str(tmp_path / "in.txt")
+    out_path = str(tmp_path / "out.txt")
+    dataset.save_boards(in_path, corpus)
+    stats = dataset.solve_file(
+        in_path,
+        out_path,
+        SUDOKU_9,
+        batch=8,
+        bulk_config=BulkConfig(chunk=8, search_lanes=32),
+    )
+    assert stats["total"] == len(corpus) and stats["solved"] == len(corpus)
+    sols = dataset.load_boards(out_path, SUDOKU_9)
+    assert len(sols) == len(corpus)
+    for g, s in zip(corpus, sols):
+        assert is_valid_solution(s)
+        assert ((g == 0) | (s == g)).all()
+
+
+def test_whitespace_lines_skipped_like_python(corpus):
+    blob = (to_line(corpus[0]) + "\n   \n\t\n" + to_line(corpus[1]) + "\n").encode()
+    got = dataset.parse_boards(blob, SUDOKU_9, allow_header=False)
+    np.testing.assert_array_equal(got, corpus[:2])
+    py = dataset._parse_python(blob, 9, allow_header=False)
+    np.testing.assert_array_equal(py, corpus[:2])
+
+
+def test_streaming_error_index_is_file_absolute(tmp_path, corpus):
+    path = str(tmp_path / "bad.txt")
+    lines = [to_line(b) for b in np.tile(corpus, (40, 1, 1))]
+    lines.insert(500, "xx-not-a-board")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="data line 500"):
+        for _ in dataset.iter_board_batches(path, SUDOKU_9, batch=64):
+            pass
+
+
+def test_solve_file_empty_input(tmp_path):
+    in_path = str(tmp_path / "empty.txt")
+    out_path = str(tmp_path / "out.txt")
+    open(in_path, "w").close()
+    stats = dataset.solve_file(in_path, out_path, SUDOKU_9, batch=8)
+    assert stats == {"total": 0, "solved": 0, "unsat": 0, "searched": 0}
+    assert open(out_path).read() == ""
