@@ -26,7 +26,10 @@ from distributed_sudoku_solver_tpu.serving.http import ApiServer
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="distributed_sudoku_solver_tpu",
-        description="TPU-native distributed constraint-satisfaction node",
+        description=(
+            "TPU-native distributed constraint-satisfaction node "
+            "(default command), or `solve-file` for offline bulk solving"
+        ),
     )
     ap.add_argument("-p", "--http-port", type=int, default=8000)
     ap.add_argument("-s", "--p2p-port", type=int, default=7000)
@@ -44,6 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")
     ap.add_argument("--heartbeat-s", type=float, default=1.0)
+    sub = ap.add_subparsers(dest="cmd", metavar="{solve-file}")
+    build_solve_file_parser(sub)
     return ap
 
 
@@ -67,8 +72,52 @@ def make_engine(args) -> SolverEngine:
     return engine
 
 
+def build_solve_file_parser(sub=None) -> argparse.ArgumentParser:
+    kwargs = dict(
+        description="Bulk-solve a puzzle file (one board per line / Kaggle CSV)",
+    )
+    ap = (
+        sub.add_parser("solve-file", help=kwargs["description"], **kwargs)
+        if sub is not None
+        else argparse.ArgumentParser(
+            prog="distributed_sudoku_solver_tpu solve-file", **kwargs
+        )
+    )
+    ap.add_argument("input", help="input board file")
+    ap.add_argument("-o", "--output", default=None, help="write solutions (line-aligned)")
+    ap.add_argument("-n", "--size", type=int, default=9, help="board size n (9/16/25)")
+    ap.add_argument("--batch", type=int, default=65536, help="boards per device batch")
+    ap.add_argument("--search-lanes", type=int, default=32768)
+    return ap
+
+
+def solve_file_main(args) -> None:
+    """`solve-file` subcommand: bulk-solve a board file through ops/bulk.py."""
+    import json
+
+    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig
+    from distributed_sudoku_solver_tpu.utils import dataset
+
+    geom = geometry_for_size(args.size)
+    t0 = time.perf_counter()
+    stats = dataset.solve_file(
+        args.input,
+        args.output,
+        geom,
+        batch=args.batch,
+        bulk_config=BulkConfig(search_lanes=args.search_lanes),
+    )
+    stats["wall_s"] = round(time.perf_counter() - t0, 3)
+    stats["boards_per_s"] = round(stats["total"] / max(stats["wall_s"], 1e-9), 1)
+    print(json.dumps(stats))
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    if getattr(args, "cmd", None) == "solve-file":
+        solve_file_main(args)
+        return
     engine = make_engine(args).start()
     node = ClusterNode(
         engine,

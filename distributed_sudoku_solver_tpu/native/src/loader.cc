@@ -22,25 +22,34 @@
 
 namespace {
 
-// Board-character value: '.'/'0' -> 0, '1'-'9' -> 1-9, 'a'-'z' -> 10-35
-// (base 36, matching utils/puzzles.py parse_line/to_line); -1 if invalid.
+// Board-character value: '.'/'0' -> 0, '1'-'9' -> 1-9, letters -> 10-35
+// (base 36 either case, matching Python's int(ch, 36) in
+// utils/puzzles.py parse_line); -1 if invalid.
 inline int char_value(char ch) {
   if (ch == '.' || ch == '0') return 0;
   if (ch >= '1' && ch <= '9') return ch - '0';
   if (ch >= 'a' && ch <= 'z') return ch - 'a' + 10;
+  if (ch >= 'A' && ch <= 'Z') return ch - 'A' + 10;
   return -1;
 }
 
 struct LineSpan {
   const char* begin;
-  int64_t len;  // excluding newline
+  int64_t len;  // excluding newline, outer whitespace trimmed
 };
+
+// First comma-separated field of the (pre-trimmed) line.
+inline int64_t field_len(const LineSpan& line) {
+  for (int64_t i = 0; i < line.len; ++i) {
+    if (line.begin[i] == ',') return i;
+  }
+  return line.len;
+}
 
 // Parse one line's first field into out[n*n]; returns true on success.
 bool parse_line(const LineSpan& line, int n, int32_t* out) {
   const int cells = n * n;
-  if (line.len < cells) return false;
-  if (line.len > cells && line.begin[cells] != ',') return false;
+  if (field_len(line) != cells) return false;
   for (int i = 0; i < cells; ++i) {
     const int v = char_value(line.begin[i]);
     if (v < 0 || v > n) return false;
@@ -49,23 +58,17 @@ bool parse_line(const LineSpan& line, int n, int32_t* out) {
   return true;
 }
 
-inline bool all_space(const char* p, int64_t len) {
-  for (int64_t i = 0; i < len; ++i) {
-    if (!std::isspace(static_cast<unsigned char>(p[i]))) return false;
-  }
-  return true;
-}
-
 void split_lines(const char* buf, int64_t len, std::vector<LineSpan>* lines) {
   int64_t start = 0;
   for (int64_t i = 0; i <= len; ++i) {
     if (i == len || buf[i] == '\n') {
-      int64_t end = i;
-      if (end > start && buf[end - 1] == '\r') --end;  // CRLF
-      // Whitespace-only lines count as empty (matches the Python fallback).
-      if (end > start && !all_space(buf + start, end - start)) {
-        lines->push_back({buf + start, end - start});
-      }
+      // Trim outer whitespace (editors/CSV exports pad lines; the Python
+      // fallback .strip()s, and the two must agree byte-for-byte on which
+      // lines exist) — whitespace-only lines count as empty.
+      int64_t b = start, e = i;
+      while (e > b && std::isspace(static_cast<unsigned char>(buf[e - 1]))) --e;
+      while (b < e && std::isspace(static_cast<unsigned char>(buf[b]))) ++b;
+      if (e > b) lines->push_back({buf + b, e - b});
       start = i + 1;
     }
   }
@@ -90,10 +93,13 @@ int64_t csp_parse_boards(const char* buf, int64_t len, int n, int32_t* out,
   split_lines(buf, len, &lines);
   if (lines.empty()) return 0;
 
+  // Header detection: only a first line whose *field length* differs from
+  // n*n can be a header (e.g. "quizzes,solutions").  A right-length line
+  // with a bad character is a malformed board and errors like any other —
+  // silently skipping it would shift every output line by one.
   int64_t first = 0;
-  if (allow_header != 0) {
-    std::vector<int32_t> scratch(static_cast<size_t>(n) * n);
-    if (!parse_line(lines[0], n, scratch.data())) first = 1;
+  if (allow_header != 0 && field_len(lines[0]) != static_cast<int64_t>(n) * n) {
+    first = 1;
   }
   const int64_t count =
       std::min<int64_t>(max_boards, static_cast<int64_t>(lines.size()) - first);

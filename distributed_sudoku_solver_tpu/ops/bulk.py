@@ -79,11 +79,6 @@ def _auto_propagator() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
-def _trivial_board(geom: Geometry) -> np.ndarray:
-    """A complete valid board: the zero-work padding job for partial chunks."""
-    from distributed_sudoku_solver_tpu.utils.puzzles import random_solution
-
-    return np.asarray(random_solution(geom, seed=0), dtype=np.int32)
 
 
 def _propagate_stage(cand: jax.Array, geom: Geometry, cfg: BulkConfig):
@@ -174,7 +169,9 @@ def solve_bulk(
         # on step one and immediately turns thief, joining the OR-parallel
         # gang on the real jobs (padding with a survivor copy would instead
         # burn those lanes re-searching the hardest board).
-        pad_board = _trivial_board(geom)
+        from distributed_sudoku_solver_tpu.utils.puzzles import solved_board
+
+        pad_board = solved_board(geom)
         still: list[int] = []
         for lo in range(0, len(remaining), jobs_per_chunk):
             idx = remaining[lo : lo + jobs_per_chunk]

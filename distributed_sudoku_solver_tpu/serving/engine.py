@@ -190,9 +190,13 @@ class SolverEngine:
         grids = np.zeros((bucket, n, n), dtype=np.int32)
         for i, job in enumerate(group):
             grids[i] = job.grid
-        # Padding rows replicate the first grid: no new compile shapes, and the
-        # duplicate work is masked out of all stats below.
-        grids[len(group) :] = group[0].grid
+        # Padding rows hold a pre-solved board: their lanes resolve on step
+        # one and immediately join the steal pool as thieves for the real
+        # jobs (a replicated real grid would instead re-search it).  Masked
+        # out of all stats below.
+        from distributed_sudoku_solver_tpu.utils.puzzles import solved_board
+
+        grids[len(group) :] = solved_board(geom)
 
         res = self._solve_fn(grids, geom, self.config)
         solved = np.asarray(res.solved)

@@ -24,14 +24,17 @@ from distributed_sudoku_solver_tpu.utils.puzzles import parse_line, to_line
 
 def _parse_python(data: bytes, n: int, allow_header: bool) -> np.ndarray:
     boards = []
-    lines = [ln for ln in data.decode().splitlines() if ln.strip()]
+    lines = [ln.strip() for ln in data.decode().splitlines() if ln.strip()]
     for i, raw in enumerate(lines):
-        line = raw.split(",")[0].strip()
+        field = raw.split(",")[0].strip()
+        # Header semantics must match loader.cc exactly: only a first line
+        # whose field *length* differs from n*n may be skipped as a header;
+        # a right-length line with a bad character is an error anywhere.
+        if i == 0 and allow_header and len(field) != n * n:
+            continue
         try:
-            boards.append(parse_line(line, n))
+            boards.append(parse_line(field, n))
         except ValueError:
-            if i == 0 and allow_header:
-                continue
             raise ValueError(f"malformed board at data line {len(boards)}")
     if not boards:
         return np.zeros((0, n, n), dtype=np.int32)

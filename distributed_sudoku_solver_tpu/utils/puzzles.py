@@ -10,6 +10,7 @@ truncates 25x25 tasks, ``/root/reference/DHT_Node.py:94``, SURVEY.md §2.5 #8).
 
 from __future__ import annotations
 
+import functools as _functools
 import os
 from typing import Optional
 
@@ -177,3 +178,16 @@ def puzzle_batch(
         np.save(tmp, batch)
         os.replace(tmp, path)
     return batch
+
+
+@_functools.lru_cache(maxsize=None)
+def solved_board(geom: Geometry) -> np.ndarray:
+    """A complete valid board for ``geom`` (cached; read-only).
+
+    The canonical zero-work padding job: batch paths pad partial chunks with
+    it so the padding lanes resolve on step one and join the steal pool as
+    thieves for the real jobs.
+    """
+    board = random_solution(geom, seed=0).astype(np.int32)
+    board.setflags(write=False)
+    return board

@@ -114,3 +114,27 @@ def test_solve_file_empty_input(tmp_path):
     stats = dataset.solve_file(in_path, out_path, SUDOKU_9, batch=8)
     assert stats == {"total": 0, "solved": 0, "unsat": 0, "searched": 0}
     assert open(out_path).read() == ""
+
+
+def test_right_length_bad_first_line_raises_not_skips(corpus):
+    # A first line with correct length but an invalid char is a malformed
+    # board, NOT a header: silently skipping it would misalign every output.
+    bad = "x" * 81  # 'x'=33 > 9, right length
+    blob = (bad + "\n" + to_line(corpus[0]) + "\n").encode()
+    with pytest.raises(ValueError):
+        dataset.parse_boards(blob, SUDOKU_9, allow_header=True)
+    py_err = None
+    try:
+        dataset._parse_python(blob, 9, allow_header=True)
+    except ValueError as e:
+        py_err = e
+    assert py_err is not None
+
+
+def test_padded_and_uppercase_lines_parse_same(corpus):
+    line = "  " + to_line(corpus[0]).upper() + "  "
+    blob = (line + "\n").encode()
+    got = dataset.parse_boards(blob, SUDOKU_9, allow_header=False)
+    py = dataset._parse_python(blob, 9, allow_header=False)
+    np.testing.assert_array_equal(got, corpus[:1])
+    np.testing.assert_array_equal(py, corpus[:1])
