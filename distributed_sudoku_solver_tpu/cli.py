@@ -47,6 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")
     ap.add_argument("--heartbeat-s", type=float, default=1.0)
+    ap.add_argument(
+        "--profile-dir",
+        type=str,
+        default=None,
+        help="capture a jax.profiler device trace for the node's lifetime "
+        "(TensorBoard-compatible; SURVEY.md §5.1)",
+    )
     sub = ap.add_subparsers(dest="cmd", metavar="{solve-file}")
     build_solve_file_parser(sub)
     return ap
@@ -72,17 +79,9 @@ def make_engine(args) -> SolverEngine:
     return engine
 
 
-def build_solve_file_parser(sub=None) -> argparse.ArgumentParser:
-    kwargs = dict(
-        description="Bulk-solve a puzzle file (one board per line / Kaggle CSV)",
-    )
-    ap = (
-        sub.add_parser("solve-file", help=kwargs["description"], **kwargs)
-        if sub is not None
-        else argparse.ArgumentParser(
-            prog="distributed_sudoku_solver_tpu solve-file", **kwargs
-        )
-    )
+def build_solve_file_parser(sub) -> argparse.ArgumentParser:
+    desc = "Bulk-solve a puzzle file (one board per line / Kaggle CSV)"
+    ap = sub.add_parser("solve-file", help=desc, description=desc)
     ap.add_argument("input", help="input board file")
     ap.add_argument("-o", "--output", default=None, help="write solutions (line-aligned)")
     ap.add_argument("-n", "--size", type=int, default=9, help="board size n (9/16/25)")
@@ -118,28 +117,34 @@ def main(argv=None) -> None:
     if getattr(args, "cmd", None) == "solve-file":
         solve_file_main(args)
         return
-    engine = make_engine(args).start()
-    node = ClusterNode(
-        engine,
-        host=args.host,
-        port=args.p2p_port,
-        anchor=parse_addr(args.anchor) if args.anchor else None,
-        config=ClusterConfig(heartbeat_s=args.heartbeat_s),
-        advertise_host=args.advertise_host,
-    ).start()
-    api = ApiServer(node, host=args.host, port=args.http_port, verbose=True).start()
-    print(
-        f"node up: http={args.host}:{api.port} p2p={node.addr_s} "
-        f"coordinator={node.coordinator}"
-    )
-    try:
-        while True:
-            time.sleep(1)
-    except KeyboardInterrupt:
-        print("stopping...")
-        api.stop()
-        node.stop()
-        engine.stop()
+    import contextlib
+
+    from distributed_sudoku_solver_tpu.utils.profiling import device_trace
+
+    trace = device_trace(args.profile_dir) if args.profile_dir else contextlib.nullcontext()
+    with trace:  # try/finally semantics: the trace survives any exit path
+        engine = make_engine(args).start()
+        node = ClusterNode(
+            engine,
+            host=args.host,
+            port=args.p2p_port,
+            anchor=parse_addr(args.anchor) if args.anchor else None,
+            config=ClusterConfig(heartbeat_s=args.heartbeat_s),
+            advertise_host=args.advertise_host,
+        ).start()
+        api = ApiServer(node, host=args.host, port=args.http_port, verbose=True).start()
+        print(
+            f"node up: http={args.host}:{api.port} p2p={node.addr_s} "
+            f"coordinator={node.coordinator}"
+        )
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            print("stopping...")
+            api.stop()
+            node.stop()
+            engine.stop()
 
 
 if __name__ == "__main__":

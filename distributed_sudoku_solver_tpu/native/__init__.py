@@ -95,8 +95,6 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int, ctypes.c_int,
         ]
         lib.csp_parse_boards.restype = ctypes.c_int64
-        lib.csp_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64]
-        lib.csp_count_lines.restype = ctypes.c_int64
         lib.csp_format_boards.argtypes = [
             i32p, ctypes.c_int64, ctypes.c_int, ctypes.c_char_p,
         ]

@@ -38,12 +38,22 @@ struct LineSpan {
   int64_t len;  // excluding newline, outer whitespace trimmed
 };
 
-// First comma-separated field of the (pre-trimmed) line.
+// First comma-separated field of the (pre-trimmed) line, with whitespace
+// adjacent to the comma trimmed — byte-for-byte the Python fallback's
+// raw.split(',')[0].strip().
 inline int64_t field_len(const LineSpan& line) {
+  int64_t end = line.len;
   for (int64_t i = 0; i < line.len; ++i) {
-    if (line.begin[i] == ',') return i;
+    if (line.begin[i] == ',') {
+      end = i;
+      break;
+    }
   }
-  return line.len;
+  while (end > 0 &&
+         std::isspace(static_cast<unsigned char>(line.begin[end - 1]))) {
+    --end;
+  }
+  return end;
 }
 
 // Parse one line's first field into out[n*n]; returns true on success.
@@ -129,14 +139,6 @@ int64_t csp_parse_boards(const char* buf, int64_t len, int n, int32_t* out,
     if (bad[t] >= 0) return -(bad[t] + 1);
   }
   return count;
-}
-
-// Count non-empty (non-whitespace) lines, so the caller can size the output
-// array: an upper bound; exact sizing happens via csp_parse_boards' return.
-int64_t csp_count_lines(const char* buf, int64_t len) {
-  std::vector<LineSpan> lines;
-  split_lines(buf, len, &lines);
-  return static_cast<int64_t>(lines.size());
 }
 
 // Render boards back to text lines (inverse of csp_parse_boards; no commas).

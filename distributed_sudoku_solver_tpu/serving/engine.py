@@ -79,6 +79,10 @@ class SolverEngine:
         self._solve_fn = solve_fn or (
             lambda grids, geom, cfg: solve_batch(grids, geom, cfg)
         )
+        from distributed_sudoku_solver_tpu.utils.profiling import StatWindow
+
+        self.latency = StatWindow()  # seconds per job
+        self.batch_sizes = StatWindow()  # jobs per device batch
         self._queue: "queue.Queue[Job]" = queue.Queue()
         # Insertion-ordered so stale entries (cancels for jobs that already
         # finished or never arrive) can be pruned oldest-first.
@@ -124,6 +128,24 @@ class SolverEngine:
             "solved": int(self.solved_count),
             "jobs_done": int(self.jobs_done),
         }
+
+    def metrics(self) -> dict:
+        """Extended observability (GET /metrics): latency percentiles over
+        the last ~1k jobs, batch sizes, and the base counters."""
+        out = dict(self.stats())
+        lat = self.latency.snapshot()
+        if lat:
+            out["job_latency_ms"] = {
+                "count": lat["count"],
+                **{k: round(lat[k] * 1e3, 3) for k in ("p50", "p95", "p99")},
+            }
+        bs = self.batch_sizes.snapshot()
+        if bs:
+            out["batch_jobs"] = {
+                "count": bs["count"],
+                **{k: round(bs[k], 1) for k in ("p50", "p95")},
+            }
+        return out
 
     # -- device loop ---------------------------------------------------------
     def _take_batch(self) -> list[Job]:
@@ -204,6 +226,7 @@ class SolverEngine:
         solutions = np.asarray(res.solution)
         nodes = np.asarray(res.nodes)
 
+        now = time.monotonic()
         for i, job in enumerate(group):
             job.solved = bool(solved[i])
             job.unsat = bool(unsat[i])
@@ -212,7 +235,9 @@ class SolverEngine:
                 job.solution = solutions[i]
             if self._consume_cancel(job):
                 job.cancelled = True
+            self.latency.record(now - job.submitted_at)
             job.done.set()
+        self.batch_sizes.record(float(len(group)))
         self.validations += int(nodes[: len(group)].sum())
         self.solved_count += int(solved[: len(group)].sum())
         self.jobs_done += len(group)

@@ -74,7 +74,24 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, node.stats_view())
         if self.path == "/network":
             return self._send(200, node.network_view())
+        if self.path == "/metrics":
+            # Superset endpoint (not in the reference): per-node latency
+            # percentiles, batch sizes, device info — SURVEY.md §5.5.
+            return self._send(200, self._metrics(node))
         return self._send(404, {"error": "not found"})
+
+    @staticmethod
+    def _metrics(node) -> dict:
+        engine = getattr(node, "engine", None)
+        body = engine.metrics() if engine is not None else {}
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            body["device"] = {"kind": dev.device_kind, "platform": dev.platform}
+        except Exception:  # pragma: no cover - no backend
+            pass
+        return body
 
     def _send(self, code: int, body: dict) -> None:
         data = json.dumps(body).encode()

@@ -1,0 +1,80 @@
+"""Tracing / profiling subsystem (SURVEY.md §5.1).
+
+The reference's only observability is accidental print-noise (its log guard
+is tautological, ``/root/reference/DHT_Node.py:223``) plus a wall-clock
+``duration`` in the HTTP reply.  Here:
+
+* :func:`device_trace` wraps ``jax.profiler`` — TensorBoard-compatible
+  device traces (op timeline, HBM, fusion) for any code region;
+* :class:`StatWindow` keeps a bounded ring of recent samples (per-job
+  latencies, batch sizes) with percentile readout, surfaced by the engine
+  under ``GET /metrics``;
+* the per-solve counters (steps, sweeps, expansions, steals — every
+  ``SolveResult`` carries them) come from the device loop itself, not a
+  sampling sleep like the reference's 1 s `/stats` gather window.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler device trace into ``logdir`` (TensorBoard).
+
+    Usage::
+
+        with device_trace("/tmp/trace"):
+            solve_batch(...)  # traced region
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StatWindow:
+    """Bounded ring buffer of numeric samples with percentile snapshots.
+
+    Unit-agnostic (latencies in seconds, batch sizes in jobs, ...).
+    Single-writer friendly: the device loop records; any thread reads a
+    consistent-enough snapshot — readers tolerate torn windows, the same
+    contract as the engine's counters.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self._buf = np.zeros(capacity, dtype=np.float64)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def record(self, value: float) -> None:
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = value
+            self._n += 1
+
+    def snapshot(self) -> Optional[dict]:
+        """None if empty, else ``{"count", "total", "p50", "p95", "p99"}``:
+        percentiles over the current window (its size is ``count``) and the
+        lifetime sample count as ``total``."""
+        with self._lock:
+            n = min(self._n, len(self._buf))
+            if n == 0:
+                return None
+            window = self._buf[:n].copy()
+            total = self._n
+        p50, p95, p99 = np.percentile(window, [50, 95, 99])
+        return {
+            "count": int(n),
+            "total": int(total),
+            "p50": float(p50),
+            "p95": float(p95),
+            "p99": float(p99),
+        }

@@ -109,3 +109,44 @@ def test_engine_cancel_before_run():
         assert not job.solved
     finally:
         engine.stop()
+
+
+def test_metrics_endpoint_and_window():
+    import json as _json
+    import urllib.request
+
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.serving.http import ApiServer, StandaloneNode
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+    engine = SolverEngine(config=SolverConfig(min_lanes=8, stack_slots=16)).start()
+    node = StandaloneNode(engine)
+    api = ApiServer(node, host="127.0.0.1", port=0).start()
+    try:
+        job = engine.submit(EASY_9)
+        assert job.wait(120) and job.solved
+        body = _json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{api.port}/metrics", timeout=30
+            ).read()
+        )
+        assert body["jobs_done"] >= 1
+        assert body["job_latency_ms"]["count"] >= 1
+        assert body["job_latency_ms"]["p50"] > 0
+        assert body["batch_jobs"]["p50"] >= 1
+    finally:
+        api.stop()
+        engine.stop()
+
+
+def test_stat_window_percentiles():
+    from distributed_sudoku_solver_tpu.utils.profiling import StatWindow
+
+    w = StatWindow(capacity=8)
+    assert w.snapshot() is None
+    for v in range(1, 101):  # ring wraps; window = last 8 values 93..100
+        w.record(float(v))
+    snap = w.snapshot()
+    assert snap["total"] == 100 and snap["count"] == 8
+    assert 93 <= snap["p50"] <= 100

@@ -138,3 +138,11 @@ def test_padded_and_uppercase_lines_parse_same(corpus):
     py = dataset._parse_python(blob, 9, allow_header=False)
     np.testing.assert_array_equal(got, corpus[:1])
     np.testing.assert_array_equal(py, corpus[:1])
+
+
+def test_space_before_comma_parses_like_python(corpus):
+    blob = (to_line(corpus[0]) + " ,solutioncolumn\n").encode()
+    got = dataset.parse_boards(blob, SUDOKU_9, allow_header=False)
+    py = dataset._parse_python(blob, 9, allow_header=False)
+    np.testing.assert_array_equal(got, corpus[:1])
+    np.testing.assert_array_equal(py, corpus[:1])
