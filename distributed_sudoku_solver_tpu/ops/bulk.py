@@ -24,6 +24,7 @@ the search engine.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -81,7 +82,7 @@ def _auto_propagator() -> str:
 
 
 
-def _propagate_stage(cand: jax.Array, geom: Geometry, cfg: BulkConfig):
+def _propagate_local(cand: jax.Array, geom: Geometry, cfg: BulkConfig) -> jax.Array:
     propagator = cfg.propagator or _auto_propagator()
     if propagator == "pallas":
         from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
@@ -101,6 +102,39 @@ def _propagate_stage(cand: jax.Array, geom: Geometry, cfg: BulkConfig):
         fixed, _ = propagate(cand, geom, cfg.max_sweeps)
     else:
         raise ValueError(f"unknown propagator {propagator!r}")
+    return fixed
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_propagator(geom: Geometry, cfg: BulkConfig, mesh):
+    """Jitted shard_map fixpoint, built once per (geom, cfg, mesh).
+
+    Rebuilding the lambda + shard_map per chunk would miss JAX's dispatch
+    cache and re-trace every chunk (~0.9 s/call vs ~1 ms warm, measured on
+    the 8-device CPU mesh) — all three arguments are hashable, so memoize.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    (axis,) = mesh.axis_names
+    return jax.jit(
+        jax.shard_map(
+            lambda c: _propagate_local(c, geom, cfg),
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+    )
+
+
+def _propagate_stage(cand: jax.Array, geom: Geometry, cfg: BulkConfig, mesh=None):
+    if mesh is None:
+        fixed = _propagate_local(cand, geom, cfg)
+    else:
+        # Embarrassingly parallel over the mesh: each chip runs the fixpoint
+        # on its batch shard, no collectives (the caller pads the chunk to a
+        # multiple of the mesh size with pre-solved boards).
+        fixed = _sharded_propagator(geom, cfg, mesh)(cand)
     return fixed, board_status(fixed, geom)
 
 
@@ -108,28 +142,43 @@ def solve_bulk(
     grids,
     geom: Geometry,
     config: BulkConfig = BulkConfig(),
+    mesh=None,
 ) -> BulkResult:
     """Solve ``grids`` int[B, n, n] (0 = empty); B may be huge.
 
     Stage-1 chunks stream through the device back to host verdict arrays;
     survivors are batched through the frontier engine.  Everything is
     deterministic: results are independent of chunk sizes.
+
+    With ``mesh`` (a 1-axis ``jax.sharding.Mesh``), stage 1 shards the batch
+    over the chips (no collectives needed) and stage 2 runs the sharded
+    frontier (`parallel/sharded.py`: ring-``ppermute`` work stealing,
+    ``psum`` solution broadcast over ICI).
     """
     grids = np.ascontiguousarray(np.asarray(grids, dtype=np.int32))
     b, n, _ = grids.shape
+    n_dev = 1 if mesh is None else int(mesh.devices.size)
 
     solution = np.zeros((b, n, n), dtype=np.int32)
     solved = np.zeros(b, dtype=bool)
     unsat = np.zeros(b, dtype=bool)
 
     # --- stage 1: propagate every board to its fixpoint -------------------
+    from distributed_sudoku_solver_tpu.utils.puzzles import solved_board
+
     pending: list[tuple[int, jax.Array, jax.Array, jax.Array]] = []
     for lo in range(0, b, config.chunk):
-        chunk = jnp.asarray(grids[lo : lo + config.chunk])
-        cand = encode_grid(chunk, geom)
-        fixed, st = _propagate_stage(cand, geom, config)
+        chunk = grids[lo : lo + config.chunk]
+        pad = (-len(chunk)) % n_dev
+        if pad:  # shard evenly; pre-solved pads are dropped on write-back
+            chunk = np.concatenate(
+                [chunk, np.tile(solved_board(geom)[None], (pad, 1, 1))]
+            )
+        cand = encode_grid(jnp.asarray(chunk), geom)
+        fixed, st = _propagate_stage(cand, geom, config, mesh)
         dec = decode_grid(fixed)
-        pending.append((lo, dec, st.solved, st.contradiction))
+        k = len(chunk) - pad
+        pending.append((lo, dec[:k], st.solved[:k], st.contradiction[:k]))
     for lo, dec, st_solved, st_contra in pending:
         dec, st_solved, st_contra = (
             np.asarray(dec),
@@ -169,8 +218,6 @@ def solve_bulk(
         # on step one and immediately turns thief, joining the OR-parallel
         # gang on the real jobs (padding with a survivor copy would instead
         # burn those lanes re-searching the hardest board).
-        from distributed_sudoku_solver_tpu.utils.puzzles import solved_board
-
         pad_board = solved_board(geom)
         still: list[int] = []
         for lo in range(0, len(remaining), jobs_per_chunk):
@@ -179,7 +226,14 @@ def solve_bulk(
             if len(idx) < jobs_per_chunk:  # keep one compiled shape per rung
                 pad = np.tile(pad_board[None], (jobs_per_chunk - len(idx), 1, 1))
                 batch = np.concatenate([batch, pad])
-            res = solve_batch(jnp.asarray(batch), geom, scfg)
+            if mesh is not None:
+                from distributed_sudoku_solver_tpu.parallel.sharded import (
+                    solve_batch_sharded,
+                )
+
+                res = solve_batch_sharded(jnp.asarray(batch), geom, scfg, mesh=mesh)
+            else:
+                res = solve_batch(jnp.asarray(batch), geom, scfg)
             r_sol = np.asarray(res.solution)[: len(idx)]
             r_solved = np.asarray(res.solved)[: len(idx)]
             r_unsat = np.asarray(res.unsat)[: len(idx)]

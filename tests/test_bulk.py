@@ -48,3 +48,30 @@ def test_bulk_matches_oracle_solution_on_unique_puzzles():
     assert res.solved.all()
     for g, s in zip(grids, res.solution):
         np.testing.assert_array_equal(s, solve_oracle(g))
+
+
+def test_bulk_sharded_matches_single_device():
+    import jax
+
+    from distributed_sudoku_solver_tpu.parallel import make_mesh
+
+    grids = _corpus(n_gen=8)
+    mesh = make_mesh(jax.devices())
+    a = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8, search_lanes=32))
+    s = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8, search_lanes=32), mesh=mesh)
+    np.testing.assert_array_equal(a.solved, s.solved)
+    assert s.solved.all()
+    for g, sol in zip(grids, s.solution):
+        assert is_valid_solution(sol)
+        assert ((g == 0) | (sol == g)).all()
+
+
+def test_bulk_sharded_ragged_chunk_pads_evenly():
+    import jax
+
+    from distributed_sudoku_solver_tpu.parallel import make_mesh
+
+    grids = _corpus(n_gen=1)[:5]  # 5 boards over 8 devices: pad path
+    mesh = make_mesh(jax.devices())
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=16, search_lanes=32), mesh=mesh)
+    assert res.solved.all() and len(res.solved) == 5
