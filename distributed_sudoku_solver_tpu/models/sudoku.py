@@ -26,7 +26,11 @@ class SudokuCSP:
     ``branch``: 'minrem' picks the cell with fewest remaining candidates
     (MRV, fastest); 'first' picks the first undecided cell row-major — the
     reference's ``find_next_empty`` order (``/root/reference/utils.py:14-25``),
-    used by the bit-exactness tests.
+    used by the bit-exactness tests; 'mixed' hashes each state to one of the
+    two — heuristic *diversification* across subtrees (the expert-parallel
+    analog, SURVEY.md §2.2: heterogeneous strategies per subproblem), which
+    hedges against boards adversarial to any single rule.  All rules are
+    deterministic, so solves stay reproducible.
     """
 
     geom: Geometry
@@ -35,7 +39,7 @@ class SudokuCSP:
     propagator: str = "xla"
 
     def __post_init__(self) -> None:
-        if self.branch_rule not in ("minrem", "first"):
+        if self.branch_rule not in ("minrem", "first", "mixed"):
             raise ValueError(f"unknown branch rule {self.branch_rule!r}")
         if self.propagator not in ("xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
@@ -88,10 +92,16 @@ class SudokuCSP:
         lanes = cand.shape[0]
         pc = popcount(cand).reshape(lanes, n * n).astype(jnp.int32)
         cell_idx = jnp.arange(n * n, dtype=jnp.int32)
+        minrem_key = jnp.where(pc > 1, pc * (n * n) + cell_idx, jnp.int32(2**30))
+        first_key = jnp.where(pc > 1, cell_idx, jnp.int32(2**30))
         if self.branch_rule == "minrem":
-            key = jnp.where(pc > 1, pc * (n * n) + cell_idx, jnp.int32(2**30))
-        else:  # 'first'
-            key = jnp.where(pc > 1, cell_idx, jnp.int32(2**30))
+            key = minrem_key
+        elif self.branch_rule == "first":
+            key = first_key
+        else:  # 'mixed': deterministic per-state hash picks the rule, so
+            # sibling subtrees explore under different heuristics.
+            h = jnp.sum(pc * (cell_idx + 1), axis=-1)
+            key = jnp.where((h & 1)[:, None] == 0, minrem_key, first_key)
         chosen = jnp.argmin(key, axis=-1)
         onehot = cell_idx[None, :] == chosen[:, None]
         return onehot.reshape(lanes, n, n)

@@ -80,6 +80,16 @@ def _auto_propagator() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
+def _to_wire_int8(grids: np.ndarray, geom: Geometry) -> np.ndarray:
+    """Narrow boards to int8 for the host->device link without weakening the
+    corrupt-input contract: anything outside [0, n] becomes -1, which
+    ``value_to_mask`` maps to the empty mask -> a clean unsat verdict (a
+    bare ``astype(int8)`` would *wrap* e.g. 257 into a legal-looking 1)."""
+    out = grids.astype(np.int8)
+    out[(grids < 0) | (grids > geom.n)] = -1
+    return out
+
+
 
 
 def _propagate_local(cand: jax.Array, geom: Geometry, cfg: BulkConfig) -> jax.Array:
@@ -127,15 +137,27 @@ def _sharded_propagator(geom: Geometry, cfg: BulkConfig, mesh):
     )
 
 
-def _propagate_stage(cand: jax.Array, geom: Geometry, cfg: BulkConfig, mesh=None):
-    if mesh is None:
-        fixed = _propagate_local(cand, geom, cfg)
-    else:
-        # Embarrassingly parallel over the mesh: each chip runs the fixpoint
-        # on its batch shard, no collectives (the caller pads the chunk to a
-        # multiple of the mesh size with pre-solved boards).
-        fixed = _sharded_propagator(geom, cfg, mesh)(cand)
-    return fixed, board_status(fixed, geom)
+@functools.lru_cache(maxsize=None)
+def _stage1(geom: Geometry, cfg: BulkConfig, mesh):
+    """One jitted program for a whole stage-1 chunk: encode -> fixpoint ->
+    status -> int8 decode.  A single device dispatch per chunk — running
+    the pre/post ops eagerly costs one host round-trip *per op* (~100 ms
+    each through a tunneled device; measured ~7 s/chunk, vs ~0.2 s fused).
+    """
+
+    def run(chunk8: jax.Array):
+        cand = encode_grid(chunk8, geom)
+        if mesh is None:
+            fixed = _propagate_local(cand, geom, cfg)
+        else:
+            # Embarrassingly parallel over the mesh: each chip runs the
+            # fixpoint on its batch shard, no collectives (the caller pads
+            # chunks to a multiple of the mesh size with pre-solved boards).
+            fixed = _sharded_propagator(geom, cfg, mesh)(cand)
+        st = board_status(fixed, geom)
+        return decode_grid(fixed).astype(jnp.int8), st.solved, st.contradiction
+
+    return jax.jit(run)
 
 
 def solve_bulk(
@@ -174,11 +196,14 @@ def solve_bulk(
             chunk = np.concatenate(
                 [chunk, np.tile(solved_board(geom)[None], (pad, 1, 1))]
             )
-        cand = encode_grid(jnp.asarray(chunk), geom)
-        fixed, st = _propagate_stage(cand, geom, config, mesh)
-        dec = decode_grid(fixed)
+        # Boards cross the host<->device link as int8 (digits <= 35): 4x
+        # less transfer than int32 — on tunneled/remote setups the link and
+        # the per-dispatch round-trip, not the chip, bound bulk throughput.
+        dec, st_solved, st_contra = _stage1(geom, config, mesh)(
+            jnp.asarray(_to_wire_int8(chunk, geom))
+        )
         k = len(chunk) - pad
-        pending.append((lo, dec[:k], st.solved[:k], st.contradiction[:k]))
+        pending.append((lo, dec[:k], st_solved[:k], st_contra[:k]))
     for lo, dec, st_solved, st_contra in pending:
         dec, st_solved, st_contra = (
             np.asarray(dec),
@@ -213,6 +238,9 @@ def solve_bulk(
             max_steps=config.max_steps,
             max_sweeps=config.max_sweeps,
             propagator=prop,
+            # Gang rungs (many thief lanes per job) need fast fan-out: one
+            # steal pairing per step would ramp a gang up only linearly.
+            steal_rounds=4 if lanes_per_job > 1 else 1,
         )
         # Pad partial chunks with an already-complete board: its lane solves
         # on step one and immediately turns thief, joining the OR-parallel
@@ -226,15 +254,17 @@ def solve_bulk(
             if len(idx) < jobs_per_chunk:  # keep one compiled shape per rung
                 pad = np.tile(pad_board[None], (jobs_per_chunk - len(idx), 1, 1))
                 batch = np.concatenate([batch, pad])
+            batch8 = jnp.asarray(_to_wire_int8(batch, geom))  # 4x less uplink
             if mesh is not None:
                 from distributed_sudoku_solver_tpu.parallel.sharded import (
                     solve_batch_sharded,
                 )
 
-                res = solve_batch_sharded(jnp.asarray(batch), geom, scfg, mesh=mesh)
+                res = solve_batch_sharded(batch8, geom, scfg, mesh=mesh)
             else:
-                res = solve_batch(jnp.asarray(batch), geom, scfg)
-            r_sol = np.asarray(res.solution)[: len(idx)]
+                res = solve_batch(batch8, geom, scfg)
+            # Device-side downcast so the downlink moves int8, not int32.
+            r_sol = np.asarray(res.solution.astype(jnp.int8))[: len(idx)]
             r_solved = np.asarray(res.solved)[: len(idx)]
             r_unsat = np.asarray(res.unsat)[: len(idx)]
             solution[idx] = np.where(r_solved[:, None, None], r_sol, 0)

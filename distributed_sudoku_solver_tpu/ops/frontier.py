@@ -56,10 +56,15 @@ class SolverConfig:
     stack_slots: int = 64  # DFS stack depth per lane
     max_steps: int = 100_000  # branch rounds before giving up
     max_sweeps: int = 64  # propagation sweeps per fixpoint (Sudoku adapter)
-    branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref order)
+    branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref
+    #   order, bit-exactness tests) | 'mixed' (per-state hash-diversified)
     propagator: str = "xla"  # 'xla' | 'pallas' (VMEM kernel; batch solves only
     #   — the board-sharded path has its own collective sweep and rejects it)
     steal: bool = True  # receiver-initiated work stealing between lanes
+    steal_rounds: int = 1  # pairings per step; >1 ramps idle gangs up faster
+    #   (a donor serves one thief per round, so a lone rich lane feeds at
+    #   most `steal_rounds` thieves per step — matters for wide-lane few-job
+    #   gang search, where 1 round means linear rather than quick fan-out)
     ring_steal_k: int = 8  # max boards shipped per step per chip pair (sharded)
 
     def resolve_lanes(self, n_jobs: int) -> int:
@@ -228,7 +233,10 @@ def frontier_step(
     n_steals = jnp.int32(0)
     job_arr = state.job
     if config.steal:
-        stack, sp, job_arr, n_steals = _steal(stack, sp, job_arr, job_live)
+        for _ in range(max(1, config.steal_rounds)):
+            stack, sp, job_arr, k = _steal(stack, sp, job_arr, job_live)
+            job_live = (job_arr >= 0) & ~solved[jnp.clip(job_arr, 0, n_jobs - 1)]
+            n_steals = n_steals + k
 
     return Frontier(
         stack=stack,

@@ -75,3 +75,10 @@ def test_bulk_sharded_ragged_chunk_pads_evenly():
     mesh = make_mesh(jax.devices())
     res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=16, search_lanes=32), mesh=mesh)
     assert res.solved.all() and len(res.solved) == 5
+
+
+def test_corrupt_values_stay_unsat_through_int8_wire():
+    bad = np.stack([EASY_9, EASY_9]).astype(np.int32)
+    bad[1, 0, 0] = 257  # would wrap to a legal-looking 1 via a bare int8 cast
+    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2, search_lanes=16))
+    assert res.solved[0] and not res.solved[1] and res.unsat[1]

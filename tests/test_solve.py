@@ -126,3 +126,33 @@ def test_nodes_counter_populated():
     assert int(res.expansions) == nodes.sum()
     # Inkala boards need actual search
     assert nodes.sum() > 0
+
+
+def test_mixed_branch_rule_solves_and_validates():
+    import numpy as np
+
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+    grids = np.stack([EASY_9, *HARD_9]).astype(np.int32)
+    cfg = SolverConfig(min_lanes=16, stack_slots=32, branch="mixed")
+    res = solve_batch(grids, SUDOKU_9, cfg)
+    assert np.asarray(res.solved).all()
+    for s in np.asarray(res.solution):
+        assert is_valid_solution(s)
+
+
+def test_multi_round_steal_equivalent_results():
+    import numpy as np
+
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    grids = np.stack(HARD_9).astype(np.int32)
+    r1 = solve_batch(grids, SUDOKU_9, SolverConfig(min_lanes=64, stack_slots=32))
+    r4 = solve_batch(
+        grids, SUDOKU_9, SolverConfig(min_lanes=64, stack_slots=32, steal_rounds=4)
+    )
+    np.testing.assert_array_equal(np.asarray(r1.solved), np.asarray(r4.solved))
+    np.testing.assert_array_equal(np.asarray(r1.solution), np.asarray(r4.solution))
+    # more pairings may not reduce steps, but must never break verdicts
+    assert int(r4.steals) >= 0
