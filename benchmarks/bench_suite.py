@@ -120,6 +120,32 @@ def bench_bulk(jax, B: int) -> None:
     )
 
 
+def bench_bulk_easy(jax, B: int) -> None:
+    """Kaggle-1M-style workload: 36-clue boards, ~99% solved by propagation
+    alone — measures the stage-1-dominated (link + fixpoint) regime."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+    from distributed_sudoku_solver_tpu.utils.puzzles import puzzle_batch
+
+    assert B % 2048 == 0, f"B={B} must be a multiple of the 2048-board corpus"
+    easy = puzzle_batch(SUDOKU_9, 2048, seed=101, n_clues=36)
+    grids = np.tile(easy, (B // 2048, 1, 1))
+    cfg = BulkConfig()
+    solve_bulk(grids, SUDOKU_9, cfg)
+    t0 = time.perf_counter()
+    res = solve_bulk(grids, SUDOKU_9, cfg)
+    dt = time.perf_counter() - t0
+    emit(
+        metric="bulk_easy9x9_end_to_end",
+        value=round(len(grids) / dt, 1),
+        unit="boards/s",
+        batch=len(grids),
+        solved=int(res.solved.sum()),
+        searched=res.searched,
+        wall_s=round(dt, 3),
+    )
+
+
 def bench_latency(jax) -> None:
     from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
     from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
@@ -210,6 +236,7 @@ def main() -> None:
     bench_propagation(jax, jnp, B)
     bench_latency(jax)
     bench_bulk(jax, 8192 if args.quick else 32768)
+    bench_bulk_easy(jax, 16384 if args.quick else 131072)
     bench_geometry(jax, args.quick)
     bench_loader()
 

@@ -92,68 +92,64 @@ def _to_wire_int8(grids: np.ndarray, geom: Geometry) -> np.ndarray:
 
 
 
-def _propagate_local(cand: jax.Array, geom: Geometry, cfg: BulkConfig) -> jax.Array:
-    propagator = cfg.propagator or _auto_propagator()
+def _propagate_local(
+    cand: jax.Array, geom: Geometry, max_sweeps: int, propagator: str
+) -> jax.Array:
     if propagator == "pallas":
         from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
             propagate_fixpoint_pallas,
         )
 
-        fixed, _ = propagate_fixpoint_pallas(cand, geom, cfg.max_sweeps)
+        fixed, _ = propagate_fixpoint_pallas(cand, geom, max_sweeps)
     elif propagator == "slices":
         from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
             propagate_fixpoint_slices,
         )
 
-        fixed, _ = propagate_fixpoint_slices(cand, geom, cfg.max_sweeps)
+        fixed, _ = propagate_fixpoint_slices(cand, geom, max_sweeps)
     elif propagator == "xla":
         from distributed_sudoku_solver_tpu.ops.propagate import propagate
 
-        fixed, _ = propagate(cand, geom, cfg.max_sweeps)
+        fixed, _ = propagate(cand, geom, max_sweeps)
     else:
         raise ValueError(f"unknown propagator {propagator!r}")
     return fixed
 
 
-@functools.lru_cache(maxsize=None)
-def _sharded_propagator(geom: Geometry, cfg: BulkConfig, mesh):
-    """Jitted shard_map fixpoint, built once per (geom, cfg, mesh).
-
-    Rebuilding the lambda + shard_map per chunk would miss JAX's dispatch
-    cache and re-trace every chunk (~0.9 s/call vs ~1 ms warm, measured on
-    the 8-device CPU mesh) — all three arguments are hashable, so memoize.
-    """
+def _sharded_propagator(geom: Geometry, max_sweeps: int, propagator: str, mesh):
     from jax.sharding import PartitionSpec as P
 
     (axis,) = mesh.axis_names
-    return jax.jit(
-        jax.shard_map(
-            lambda c: _propagate_local(c, geom, cfg),
-            mesh=mesh,
-            in_specs=P(axis),
-            out_specs=P(axis),
-            check_vma=False,
-        )
+    return jax.shard_map(
+        lambda c: _propagate_local(c, geom, max_sweeps, propagator),
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
     )
 
 
 @functools.lru_cache(maxsize=None)
-def _stage1(geom: Geometry, cfg: BulkConfig, mesh):
+def _stage1(geom: Geometry, max_sweeps: int, propagator: str, mesh):
     """One jitted program for a whole stage-1 chunk: encode -> fixpoint ->
     status -> int8 decode.  A single device dispatch per chunk — running
     the pre/post ops eagerly costs one host round-trip *per op* (~100 ms
     each through a tunneled device; measured ~7 s/chunk, vs ~0.2 s fused).
+
+    Memoized (rebuilding the closure per chunk re-traces every call,
+    ~0.9 s/chunk measured) and keyed only on what stage 1 actually uses —
+    BulkConfigs differing in stage-2 fields share one compilation.
     """
 
     def run(chunk8: jax.Array):
         cand = encode_grid(chunk8, geom)
         if mesh is None:
-            fixed = _propagate_local(cand, geom, cfg)
+            fixed = _propagate_local(cand, geom, max_sweeps, propagator)
         else:
             # Embarrassingly parallel over the mesh: each chip runs the
             # fixpoint on its batch shard, no collectives (the caller pads
             # chunks to a multiple of the mesh size with pre-solved boards).
-            fixed = _sharded_propagator(geom, cfg, mesh)(cand)
+            fixed = _sharded_propagator(geom, max_sweeps, propagator, mesh)(cand)
         st = board_status(fixed, geom)
         return decode_grid(fixed).astype(jnp.int8), st.solved, st.contradiction
 
@@ -199,7 +195,10 @@ def solve_bulk(
         # Boards cross the host<->device link as int8 (digits <= 35): 4x
         # less transfer than int32 — on tunneled/remote setups the link and
         # the per-dispatch round-trip, not the chip, bound bulk throughput.
-        dec, st_solved, st_contra = _stage1(geom, config, mesh)(
+        stage1 = _stage1(
+            geom, config.max_sweeps, config.propagator or _auto_propagator(), mesh
+        )
+        dec, st_solved, st_contra = stage1(
             jnp.asarray(_to_wire_int8(chunk, geom))
         )
         k = len(chunk) - pad

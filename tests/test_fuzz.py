@@ -1,0 +1,54 @@
+"""Randomized differential tests: the TPU pipeline vs the Python oracle.
+
+The SURVEY.md §4 property layer: on *arbitrary* random boards (not just
+well-formed puzzles) every verdict must agree with the independent oracle —
+solved implies a valid completion of the input, unsat implies the oracle
+finds no solution, and unique-solution boards decode bit-exactly.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+from distributed_sudoku_solver_tpu.utils.oracle import (
+    is_valid_solution,
+    solve_oracle,
+)
+from distributed_sudoku_solver_tpu.utils.puzzles import random_solution
+
+
+def _random_boards(seed: int, count: int, keep_lo=0.3, keep_hi=0.9):
+    """Boards made by masking random *valid* solutions plus random noise
+    boards (which are usually inconsistent): both verdict paths get hit."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(count):
+        if i % 3 < 2:  # masked valid solution: sat (maybe multi-solution)
+            sol = random_solution(SUDOKU_9, seed * 1000 + i)
+            keep = rng.random((9, 9)) < rng.uniform(keep_lo, keep_hi)
+            out.append(np.where(keep, sol, 0))
+        else:  # random scribble: usually unsat or inconsistent
+            board = np.zeros((9, 9), dtype=np.int64)
+            for _ in range(rng.integers(8, 30)):
+                r, c = rng.integers(0, 9, 2)
+                board[r, c] = rng.integers(1, 10)
+            out.append(board)
+    return np.stack(out).astype(np.int32)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_bulk_verdicts_match_oracle_on_random_boards(seed):
+    grids = _random_boards(seed, 24)
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=24, search_lanes=64))
+    for i, g in enumerate(grids):
+        oracle_sol = solve_oracle(g)
+        if res.solved[i]:
+            s = res.solution[i]
+            assert is_valid_solution(s), f"board {i}: invalid solution"
+            assert ((g == 0) | (s == g)).all(), f"board {i}: clue changed"
+            assert oracle_sol is not None, f"board {i}: oracle says unsat"
+        elif res.unsat[i]:
+            assert oracle_sol is None, f"board {i}: oracle disagrees on unsat"
+        # neither solved nor unsat (budget exhausted) never happens at 9x9
+        assert res.solved[i] or res.unsat[i], f"board {i}: unresolved"

@@ -50,3 +50,17 @@ def test_25x25_unsat_detected():
     res = solve_batch(puzzle[None], SUDOKU_25, cfg)
     assert not bool(res.solved[0])
     assert bool(res.unsat[0])
+
+
+def test_12x12_rectangular_boxes():
+    """Non-square boxes (3x4): the geometry axis the reference could never
+    parameterize; also exercises n_vboxes != n_hboxes paths."""
+    from distributed_sudoku_solver_tpu.models.geometry import Geometry
+
+    geom = Geometry(3, 4)
+    assert geom.n == 12 and geom.n_vboxes == 4 and geom.n_hboxes == 3
+    puzzle = make_puzzle(geom, seed=9, n_clues=90, unique=False)
+    cfg = SolverConfig(min_lanes=8, stack_slots=48, max_steps=50_000)
+    res = solve_batch(puzzle[None], geom, cfg)
+    assert bool(res.solved[0])
+    _check(np.asarray(res.solution[0]), puzzle, geom)
