@@ -37,12 +37,19 @@ class SudokuCSP:
     branch_rule: str = "minrem"
     max_sweeps: int = 64
     propagator: str = "xla"
+    rules: str = "basic"
 
     def __post_init__(self) -> None:
         if self.branch_rule not in ("minrem", "first", "mixed"):
             raise ValueError(f"unknown branch rule {self.branch_rule!r}")
         if self.propagator not in ("xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
+        if self.rules not in ("basic", "extended"):
+            raise ValueError(f"unknown rules {self.rules!r}")
+        if self.rules == "extended" and self.propagator != "xla":
+            # box_line_sweep needs reshapes Mosaic rejects; fail loudly
+            # rather than silently dropping the stronger inference.
+            raise ValueError("rules='extended' requires propagator='xla'")
 
     @property
     def state_shape(self) -> tuple[int, int]:
@@ -66,7 +73,7 @@ class SudokuCSP:
             )
 
             return propagate_fixpoint_slices(states, self.geom, self.max_sweeps)
-        return propagate(states, self.geom, self.max_sweeps)
+        return propagate(states, self.geom, self.max_sweeps, self.rules)
 
     def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
         st = board_status(states, self.geom)
@@ -109,5 +116,5 @@ class SudokuCSP:
     def signature(self) -> str:
         return (
             f"sudoku:{self.geom.box_h}x{self.geom.box_w}"
-            f":{self.branch_rule}:{self.max_sweeps}:{self.propagator}"
+            f":{self.branch_rule}:{self.max_sweeps}:{self.propagator}:{self.rules}"
         )

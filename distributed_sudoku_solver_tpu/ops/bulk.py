@@ -55,6 +55,8 @@ class BulkConfig:
     max_steps: int = 100_000
     max_sweeps: int = 64
     propagator: Optional[str] = None  # stage 1; None = auto (pallas on TPU)
+    rules: str = "basic"  # 'extended' adds box-line reductions (xla-only:
+    #   forces the xla propagator in stage 1 and the search rungs)
     # Escalation rungs for unresolved boards: (max jobs/chunk, lanes per job,
     # stack slots).  Wider-than-jobs lanes give straggler jobs an OR-parallel
     # gang of thief lanes; deep stacks make overflow impossible in practice.
@@ -63,6 +65,10 @@ class BulkConfig:
     def __post_init__(self) -> None:
         if self.propagator not in (None, "xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
+        if self.rules not in ("basic", "extended"):
+            raise ValueError(f"unknown rules {self.rules!r}")
+        if self.rules == "extended" and self.propagator not in (None, "xla"):
+            raise ValueError("rules='extended' requires the 'xla' propagator")
 
 
 @dataclasses.dataclass
@@ -93,7 +99,8 @@ def _to_wire_int8(grids: np.ndarray, geom: Geometry) -> np.ndarray:
 
 
 def _propagate_local(
-    cand: jax.Array, geom: Geometry, max_sweeps: int, propagator: str
+    cand: jax.Array, geom: Geometry, max_sweeps: int, propagator: str,
+    rules: str = "basic",
 ) -> jax.Array:
     if propagator == "pallas":
         from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
@@ -110,18 +117,18 @@ def _propagate_local(
     elif propagator == "xla":
         from distributed_sudoku_solver_tpu.ops.propagate import propagate
 
-        fixed, _ = propagate(cand, geom, max_sweeps)
+        fixed, _ = propagate(cand, geom, max_sweeps, rules)
     else:
         raise ValueError(f"unknown propagator {propagator!r}")
     return fixed
 
 
-def _sharded_propagator(geom: Geometry, max_sweeps: int, propagator: str, mesh):
+def _sharded_propagator(geom, max_sweeps, propagator, rules, mesh):
     from jax.sharding import PartitionSpec as P
 
     (axis,) = mesh.axis_names
     return jax.shard_map(
-        lambda c: _propagate_local(c, geom, max_sweeps, propagator),
+        lambda c: _propagate_local(c, geom, max_sweeps, propagator, rules),
         mesh=mesh,
         in_specs=P(axis),
         out_specs=P(axis),
@@ -130,7 +137,7 @@ def _sharded_propagator(geom: Geometry, max_sweeps: int, propagator: str, mesh):
 
 
 @functools.lru_cache(maxsize=None)
-def _stage1(geom: Geometry, max_sweeps: int, propagator: str, mesh):
+def _stage1(geom: Geometry, max_sweeps: int, propagator: str, rules: str, mesh):
     """One jitted program for a whole stage-1 chunk: encode -> fixpoint ->
     status -> int8 decode.  A single device dispatch per chunk — running
     the pre/post ops eagerly costs one host round-trip *per op* (~100 ms
@@ -144,12 +151,14 @@ def _stage1(geom: Geometry, max_sweeps: int, propagator: str, mesh):
     def run(chunk8: jax.Array):
         cand = encode_grid(chunk8, geom)
         if mesh is None:
-            fixed = _propagate_local(cand, geom, max_sweeps, propagator)
+            fixed = _propagate_local(cand, geom, max_sweeps, propagator, rules)
         else:
             # Embarrassingly parallel over the mesh: each chip runs the
             # fixpoint on its batch shard, no collectives (the caller pads
             # chunks to a multiple of the mesh size with pre-solved boards).
-            fixed = _sharded_propagator(geom, max_sweeps, propagator, mesh)(cand)
+            fixed = _sharded_propagator(
+                geom, max_sweeps, propagator, rules, mesh
+            )(cand)
         st = board_status(fixed, geom)
         return decode_grid(fixed).astype(jnp.int8), st.solved, st.contradiction
 
@@ -195,9 +204,10 @@ def solve_bulk(
         # Boards cross the host<->device link as int8 (digits <= 35): 4x
         # less transfer than int32 — on tunneled/remote setups the link and
         # the per-dispatch round-trip, not the chip, bound bulk throughput.
-        stage1 = _stage1(
-            geom, config.max_sweeps, config.propagator or _auto_propagator(), mesh
+        prop = config.propagator or (
+            "xla" if config.rules == "extended" else _auto_propagator()
         )
+        stage1 = _stage1(geom, config.max_sweeps, prop, config.rules, mesh)
         dec, st_solved, st_contra = stage1(
             jnp.asarray(_to_wire_int8(chunk, geom))
         )
@@ -221,7 +231,8 @@ def solve_bulk(
     # Frontier propagation backend: boards-last slice sweeps win at wide
     # lane counts; at the deep rungs' narrow widths the boards-first loop
     # fuses into VMEM anyway, so 'xla' avoids the transpose round-trips.
-    rungs = [(config.search_lanes, 1, config.stack_slots, "slices")] + [
+    rung1_prop = "slices" if config.rules == "basic" else "xla"
+    rungs = [(config.search_lanes, 1, config.stack_slots, rung1_prop)] + [
         (jobs, mult, slots, "xla") for jobs, mult, slots in config.rungs
     ]
     remaining = survivors
@@ -237,6 +248,7 @@ def solve_bulk(
             max_steps=config.max_steps,
             max_sweeps=config.max_sweeps,
             propagator=prop,
+            rules=config.rules,
             # Gang rungs (many thief lanes per job) need fast fan-out: one
             # steal pairing per step would ramp a gang up only linearly.
             steal_rounds=4 if lanes_per_job > 1 else 1,

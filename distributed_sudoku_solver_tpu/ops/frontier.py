@@ -58,6 +58,8 @@ class SolverConfig:
     max_sweeps: int = 64  # propagation sweeps per fixpoint (Sudoku adapter)
     branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref
     #   order, bit-exactness tests) | 'mixed' (per-state hash-diversified)
+    rules: str = "basic"  # propagation strength: 'basic' (elimination +
+    #   hidden singles) | 'extended' (+ box-line reductions; xla-only)
     propagator: str = "xla"  # 'xla' | 'pallas' (VMEM kernel; batch solves only
     #   — the board-sharded path has its own collective sweep and rejects it)
     steal: bool = True  # receiver-initiated work stealing between lanes

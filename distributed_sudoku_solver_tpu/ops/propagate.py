@@ -103,14 +103,20 @@ def board_status(cand: jax.Array, geom: Geometry) -> BoardStatus:
 
 
 def propagate(
-    cand: jax.Array, geom: Geometry, max_sweeps: int = 64
+    cand: jax.Array, geom: Geometry, max_sweeps: int = 64, rules: str = "basic"
 ) -> tuple[jax.Array, jax.Array]:
     """Sweep to a fixpoint (bounded by ``max_sweeps``); returns (cand, n_sweeps).
+
+    ``rules='extended'`` adds the box-line reductions (:func:`box_line_sweep`)
+    to each sweep — strictly stronger inference (fewer branch nodes, more
+    boards closed without search) at a higher per-sweep cost.
 
     The loop condition is batch-global ("any board changed"), keeping the whole
     batch in one ``lax.while_loop`` — boards that stabilized early are cheap
     no-ops in later sweeps because every op is a fused elementwise pass.
     """
+    if rules not in ("basic", "extended"):
+        raise ValueError(f"unknown rules {rules!r}")
 
     def cond(state):
         _, changed, sweeps = state
@@ -119,9 +125,81 @@ def propagate(
     def body(state):
         cur, _, sweeps = state
         nxt = propagate_sweep(cur, geom)
+        if rules == "extended":
+            nxt = box_line_sweep(nxt, geom)
         return nxt, jnp.any(nxt != cur), sweeps + 1
 
     cand, _, sweeps = jax.lax.while_loop(
         cond, body, (cand, jnp.bool_(True), jnp.int32(0))
     )
     return cand, sweeps
+
+
+def box_line_sweep(cand: jax.Array, geom: Geometry) -> jax.Array:
+    """Pointing/claiming reductions (box-line interactions), bit-parallel.
+
+    Two sound rules beyond :func:`propagate_sweep`'s basic pair:
+
+    * **pointing**: if inside a box every candidate position of digit *d*
+      lies in one box-row (box-col), then *d* is eliminated from that row
+      (col) outside the box;
+    * **claiming**: if inside a row (col) every candidate position of *d*
+      lies in one box, then *d* is eliminated from the rest of that box.
+
+    Both directions reduce to the same tensor computation on the
+    ``[..., n_v, bh, n_h, bw]`` view: per (box, box-row) compute the digit
+    bits present, find bits confined to exactly one box-row of the box
+    (pointing) or one box of the row-band (claiming), and clear them from
+    the complementary cells.  Everything is bitwise OR/AND on uint32 masks
+    over static small axes — no per-digit loop.
+    """
+    lead = cand.shape[:-2]
+    n = geom.n
+
+    def one_direction(x: jax.Array, nv: int, bh: int, nh: int, bw: int) -> jax.Array:
+        """Rows direction on x[..., n, n]; the columns call passes the
+        *transposed* box layout (nh, bw, nv, bh) — with rectangular boxes
+        the two layouts differ, and using the row layout there silently
+        misaligns box boundaries (eliminates true digits on 12x12)."""
+        v = x.reshape(*lead, nv, bh, nh, bw)
+        # seg[..., v, r, h]: digit bits present in the box-row segment
+        seg = or_reduce(v, -1)
+
+        # pointing: bits in exactly one box-row of box (v, h)
+        p_once, p_twice = once_twice_reduce(jnp.swapaxes(seg, -1, -2), -1)
+        # [..., v, h] -> [..., v, 1, h]: broadcast the confined-bit mask over r
+        point = seg & jnp.swapaxes((p_once & ~p_twice)[..., None], -1, -2)
+        # eliminate `point` bits from the same global row in *other* boxes:
+        # OR over boxes h' != h, unrolled over the small nh axis.
+        point_other = jnp.zeros_like(seg)
+        for h in range(nh):
+            others = [point[..., h2] for h2 in range(nh) if h2 != h]
+            acc = others[0]
+            for o in others[1:]:
+                acc = acc | o
+            point_other = point_other.at[..., h].set(acc)
+
+        # claiming: bits in exactly one box of the row (v, r)
+        c_once, c_twice = once_twice_reduce(seg, -1)
+        claim = seg & (c_once & ~c_twice)[..., None]
+        # eliminate `claim` bits from other box-rows of the same box.
+        claim_other = jnp.zeros_like(seg)
+        for r in range(bh):
+            others = [claim[..., r2, :] for r2 in range(bh) if r2 != r]
+            acc = others[0]
+            for o in others[1:]:
+                acc = acc | o
+            claim_other = claim_other.at[..., r, :].set(acc)
+
+        kill = (point_other | claim_other)[..., None]  # broadcast over bw
+        return (v & ~jnp.broadcast_to(kill, v.shape)).reshape(*lead, n, n)
+
+    # Decided cells must keep their singleton bit: these rules only ever
+    # remove candidates from *other* cells of the line/box, but guard anyway
+    # so a (contradictory) board can't lose its decided marker silently.
+    single = is_single(cand)
+    nv, nh, bh, bw = geom.n_vboxes, geom.n_hboxes, geom.box_h, geom.box_w
+    out = one_direction(cand, nv, bh, nh, bw)
+    out_t = one_direction(jnp.swapaxes(out, -1, -2), nh, bw, nv, bh)
+    out = jnp.swapaxes(out_t, -1, -2)
+    return jnp.where(single, cand, out)

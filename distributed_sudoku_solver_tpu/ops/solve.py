@@ -84,6 +84,7 @@ def sudoku_csp(geom: Geometry, config: SolverConfig) -> SudokuCSP:
         branch_rule=config.branch,
         max_sweeps=config.max_sweeps,
         propagator=config.propagator,
+        rules=config.rules,
     )
 
 

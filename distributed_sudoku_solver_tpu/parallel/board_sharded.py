@@ -337,6 +337,12 @@ class BandedSudoku:
 def _banded_problem(
     geom: Geometry, config: SolverConfig, n_dev: int, axis: str
 ) -> BandedSudoku:
+    if config.rules != "basic":
+        # The banded sweep implements basic inference only; fail loudly
+        # (same convention as the propagator check below).
+        raise ValueError(
+            f"board-sharded solve supports rules='basic' only, got {config.rules!r}"
+        )
     if config.propagator != "xla":
         # The banded sweep has its own ring-exchange collectives; the Pallas
         # batch kernel does not apply here.  Fail loudly rather than let the
