@@ -46,10 +46,6 @@ class SudokuCSP:
             raise ValueError(f"unknown propagator {self.propagator!r}")
         if self.rules not in ("basic", "extended"):
             raise ValueError(f"unknown rules {self.rules!r}")
-        if self.rules == "extended" and self.propagator != "xla":
-            # box_line_sweep needs reshapes Mosaic rejects; fail loudly
-            # rather than silently dropping the stronger inference.
-            raise ValueError("rules='extended' requires propagator='xla'")
 
     @property
     def state_shape(self) -> tuple[int, int]:
@@ -66,13 +62,17 @@ class SudokuCSP:
                 propagate_fixpoint_pallas,
             )
 
-            return propagate_fixpoint_pallas(states, self.geom, self.max_sweeps)
+            return propagate_fixpoint_pallas(
+                states, self.geom, self.max_sweeps, rules=self.rules
+            )
         if self.propagator == "slices":
             from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
                 propagate_fixpoint_slices,
             )
 
-            return propagate_fixpoint_slices(states, self.geom, self.max_sweeps)
+            return propagate_fixpoint_slices(
+                states, self.geom, self.max_sweeps, rules=self.rules
+            )
         return propagate(states, self.geom, self.max_sweeps, self.rules)
 
     def status(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:

@@ -55,8 +55,7 @@ class BulkConfig:
     max_steps: int = 100_000
     max_sweeps: int = 64
     propagator: Optional[str] = None  # stage 1; None = auto (pallas on TPU)
-    rules: str = "basic"  # 'extended' adds box-line reductions (xla-only:
-    #   forces the xla propagator in stage 1 and the search rungs)
+    rules: str = "basic"  # 'extended' adds box-line reductions (all backends)
     # Escalation rungs for unresolved boards: (max jobs/chunk, lanes per job,
     # stack slots).  Wider-than-jobs lanes give straggler jobs an OR-parallel
     # gang of thief lanes; deep stacks make overflow impossible in practice.
@@ -67,9 +66,6 @@ class BulkConfig:
             raise ValueError(f"unknown propagator {self.propagator!r}")
         if self.rules not in ("basic", "extended"):
             raise ValueError(f"unknown rules {self.rules!r}")
-        if self.rules == "extended" and self.propagator not in (None, "xla"):
-            raise ValueError("rules='extended' requires the 'xla' propagator")
-
 
 @dataclasses.dataclass
 class BulkResult:
@@ -107,13 +103,13 @@ def _propagate_local(
             propagate_fixpoint_pallas,
         )
 
-        fixed, _ = propagate_fixpoint_pallas(cand, geom, max_sweeps)
+        fixed, _ = propagate_fixpoint_pallas(cand, geom, max_sweeps, rules=rules)
     elif propagator == "slices":
         from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
             propagate_fixpoint_slices,
         )
 
-        fixed, _ = propagate_fixpoint_slices(cand, geom, max_sweeps)
+        fixed, _ = propagate_fixpoint_slices(cand, geom, max_sweeps, rules=rules)
     elif propagator == "xla":
         from distributed_sudoku_solver_tpu.ops.propagate import propagate
 
@@ -204,9 +200,7 @@ def solve_bulk(
         # Boards cross the host<->device link as int8 (digits <= 35): 4x
         # less transfer than int32 — on tunneled/remote setups the link and
         # the per-dispatch round-trip, not the chip, bound bulk throughput.
-        prop = config.propagator or (
-            "xla" if config.rules == "extended" else _auto_propagator()
-        )
+        prop = config.propagator or _auto_propagator()
         stage1 = _stage1(geom, config.max_sweeps, prop, config.rules, mesh)
         dec, st_solved, st_contra = stage1(
             jnp.asarray(_to_wire_int8(chunk, geom))
@@ -231,8 +225,7 @@ def solve_bulk(
     # Frontier propagation backend: boards-last slice sweeps win at wide
     # lane counts; at the deep rungs' narrow widths the boards-first loop
     # fuses into VMEM anyway, so 'xla' avoids the transpose round-trips.
-    rung1_prop = "slices" if config.rules == "basic" else "xla"
-    rungs = [(config.search_lanes, 1, config.stack_slots, rung1_prop)] + [
+    rungs = [(config.search_lanes, 1, config.stack_slots, "slices")] + [
         (jobs, mult, slots, "xla") for jobs, mult, slots in config.rungs
     ]
     remaining = survivors

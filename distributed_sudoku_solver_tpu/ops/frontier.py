@@ -59,7 +59,7 @@ class SolverConfig:
     branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref
     #   order, bit-exactness tests) | 'mixed' (per-state hash-diversified)
     rules: str = "basic"  # propagation strength: 'basic' (elimination +
-    #   hidden singles) | 'extended' (+ box-line reductions; xla-only)
+    #   hidden singles) | 'extended' (+ box-line reductions, all backends)
     propagator: str = "xla"  # 'xla' | 'pallas' (VMEM kernel; batch solves only
     #   — the board-sharded path has its own collective sweep and rejects it)
     steal: bool = True  # receiver-initiated work stealing between lanes

@@ -176,7 +176,66 @@ def sweep_mosaic(
 # --------------------------------------------------------------------------
 
 
-def _fixpoint_boards_last(cand_t: jax.Array, geom: Geometry, max_sweeps: int):
+def box_line_mosaic(
+    cand: jax.Array, geom: Geometry, row_ax: int, col_ax: int
+) -> jax.Array:
+    """Box-line reductions (pointing + claiming) from Mosaic-supported ops.
+
+    Same boolean algebra as ``ops.propagate.box_line_sweep`` (bit-equality
+    pinned by tests) but built purely from width-1 static slices, concat,
+    and fold trees — no reshapes — so it runs inside Pallas kernels and the
+    boards-last XLA path.  One call covers the *rows* direction; callers
+    invoke it twice with (row_ax, col_ax) and box dims swapped.
+    """
+    single = jax.lax.population_count(cand) == 1
+    out = _box_line_dir(cand, geom.box_h, geom.box_w, row_ax, col_ax)
+    out = _box_line_dir(out, geom.box_w, geom.box_h, col_ax, row_ax)
+    return jnp.where(single, cand, out)
+
+
+def _box_line_dir(
+    x: jax.Array, bh: int, bw: int, row_ax: int, col_ax: int
+) -> jax.Array:
+    n = _axis_len(x, row_ax)
+    nh = n // bw
+    # seg: digit bits present per (row, box-of-that-row) segment.
+    seg = _group_reduce(x, col_ax, bw, _OR)
+
+    # pointing: bits confined to one row of their (band, box) segment stack.
+    p_once, p_twice = _group_reduce(_ot_lift(seg), row_ax, bh, _ot_comb)
+    confined = _expand(p_once & ~p_twice, row_ax, bh)
+    point = seg & confined
+    # claiming: bits confined within the row to one box.
+    c_once, c_twice = _group_reduce(_ot_lift(seg), col_ax, nh, _ot_comb)
+    claim = seg & jnp.broadcast_to(c_once & ~c_twice, seg.shape)
+
+    # point eliminates from the rest of the row (other boxes): OR over h'!=h.
+    cols = [_slice1(point, col_ax, h) for h in range(nh)]
+    point_other = _concat(
+        [_fold([cols[h2] for h2 in range(nh) if h2 != h], _OR) for h in range(nh)],
+        col_ax,
+    ) if nh > 1 else jax.tree.map(jnp.zeros_like, seg)
+    # claim eliminates from the other rows of the box (within the band).
+    rows = [_slice1(claim, row_ax, r) for r in range(n)]
+    claim_other = _concat(
+        [
+            _fold(
+                [rows[(r // bh) * bh + k] for k in range(bh) if k != r % bh], _OR
+            )
+            if bh > 1
+            else jax.tree.map(jnp.zeros_like, rows[r])
+            for r in range(n)
+        ],
+        row_ax,
+    )
+
+    kill = _expand(point_other | claim_other, col_ax, bw)
+    return x & ~kill
+
+
+def _fixpoint_boards_last(
+    cand_t: jax.Array, geom: Geometry, max_sweeps: int, rules: str = "basic"
+):
     """Sweep a boards-last ``[n, n, B]`` block to its fixpoint.
 
     The single definition of the convergence loop shared by the Pallas
@@ -191,6 +250,8 @@ def _fixpoint_boards_last(cand_t: jax.Array, geom: Geometry, max_sweeps: int):
     def body(state):
         cur, _, sweeps = state
         nxt = sweep_mosaic(cur, geom, row_ax=0, col_ax=1)
+        if rules == "extended":
+            nxt = box_line_mosaic(nxt, geom, row_ax=0, col_ax=1)
         return nxt, jnp.any(nxt != cur), sweeps + 1
 
     out, _, sweeps = jax.lax.while_loop(
@@ -199,12 +260,14 @@ def _fixpoint_boards_last(cand_t: jax.Array, geom: Geometry, max_sweeps: int):
     return out, sweeps
 
 
-def _fixpoint_kernel(cand_ref, out_ref, sweeps_ref, *, geom: Geometry, max_sweeps: int):
+def _fixpoint_kernel(
+    cand_ref, out_ref, sweeps_ref, *, geom: Geometry, max_sweeps: int, rules: str
+):
     """One grid program: sweep its VMEM-resident tile of boards to a fixpoint.
 
     The tile is boards-last ``[n, n, tile]`` — see :func:`sweep_mosaic`.
     """
-    cand, sweeps = _fixpoint_boards_last(cand_ref[...], geom, max_sweeps)
+    cand, sweeps = _fixpoint_boards_last(cand_ref[...], geom, max_sweeps, rules)
     out_ref[...] = cand
     # The sweep-count buffer is unblocked (every program sees the whole
     # [n_tiles, 1] SMEM array — TPU grids run sequentially) because Mosaic
@@ -217,7 +280,7 @@ def _interpret_default() -> bool:
 
 
 def propagate_fixpoint_slices(
-    cand: jax.Array, geom: Geometry, max_sweeps: int = 64
+    cand: jax.Array, geom: Geometry, max_sweeps: int = 64, rules: str = "basic"
 ) -> tuple[jax.Array, jax.Array]:
     """Boards-last fixpoint in plain XLA (no Pallas): transpose, sweep with
     the slice-tree algebra, transpose back.
@@ -229,19 +292,24 @@ def propagate_fixpoint_slices(
     large lane counts, where it beats the Pallas kernel by skipping the
     per-while-step ``pallas_call`` overhead.
     """
+    if rules not in ("basic", "extended"):
+        raise ValueError(f"unknown rules {rules!r}")
     out_t, sweeps = _fixpoint_boards_last(
-        jnp.transpose(cand, (1, 2, 0)), geom, max_sweeps
+        jnp.transpose(cand, (1, 2, 0)), geom, max_sweeps, rules
     )
     return jnp.transpose(out_t, (2, 0, 1)), sweeps
 
 
-@functools.partial(jax.jit, static_argnames=("geom", "max_sweeps", "tile", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("geom", "max_sweeps", "tile", "interpret", "rules")
+)
 def propagate_fixpoint_pallas(
     cand: jax.Array,
     geom: Geometry,
     max_sweeps: int = 64,
     tile: int = 256,
     interpret: bool | None = None,
+    rules: str = "basic",
 ) -> tuple[jax.Array, jax.Array]:
     """Drop-in for :func:`ops.propagate.propagate` on a ``[B, n, n]`` batch.
 
@@ -251,6 +319,8 @@ def propagate_fixpoint_pallas(
     """
     if cand.ndim != 3:
         raise ValueError(f"expected [B, n, n], got {cand.shape}")
+    if rules not in ("basic", "extended"):
+        raise ValueError(f"unknown rules {rules!r}")
     b, n, _ = cand.shape
     interp = _interpret_default() if interpret is None else interpret
 
@@ -266,7 +336,9 @@ def propagate_fixpoint_pallas(
     # (see sweep_mosaic on why boards-first is catastrophic for Mosaic).
     cand_t = jnp.transpose(cand, (1, 2, 0))
 
-    kernel = functools.partial(_fixpoint_kernel, geom=geom, max_sweeps=max_sweeps)
+    kernel = functools.partial(
+        _fixpoint_kernel, geom=geom, max_sweeps=max_sweeps, rules=rules
+    )
     vmem = dict(memory_space=_VMEM) if (_VMEM is not None and not interp) else {}
     smem = dict(memory_space=_SMEM) if (_SMEM is not None and not interp) else {}
     out_t, sweeps = pl.pallas_call(

@@ -77,3 +77,58 @@ def test_solve_batch_pallas_propagator_end_to_end():
     rp = solve_batch(grids, SUDOKU_9, cfg_p)
     assert np.asarray(rx.solved).all() and np.asarray(rp.solved).all()
     np.testing.assert_array_equal(np.asarray(rx.solution), np.asarray(rp.solution))
+
+
+@pytest.mark.parametrize("geom", [SUDOKU_6, SUDOKU_9])
+def test_box_line_mosaic_matches_xla(geom):
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import box_line_mosaic
+    from distributed_sudoku_solver_tpu.ops.propagate import box_line_sweep
+
+    cand = _random_cands(geom, 48, seed=13 + geom.n)
+    ref = box_line_sweep(cand, geom)
+    got = box_line_mosaic(cand, geom, row_ax=1, col_ax=2)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_box_line_mosaic_rectangular_boxes():
+    from distributed_sudoku_solver_tpu.models.geometry import Geometry
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import box_line_mosaic
+    from distributed_sudoku_solver_tpu.ops.propagate import box_line_sweep
+
+    geom = Geometry(3, 4)
+    cand = _random_cands(geom, 16, seed=99)
+    np.testing.assert_array_equal(
+        np.asarray(box_line_sweep(cand, geom)),
+        np.asarray(box_line_mosaic(cand, geom, row_ax=1, col_ax=2)),
+    )
+
+
+@pytest.mark.parametrize("backend", ["pallas", "slices"])
+def test_extended_fixpoint_parity_all_backends(backend):
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+        propagate_fixpoint_pallas,
+        propagate_fixpoint_slices,
+    )
+    from distributed_sudoku_solver_tpu.ops.propagate import propagate
+
+    grids = np.stack([EASY_9, *HARD_9] * 4).astype(np.int32)
+    cand = encode_grid(jnp.asarray(grids), SUDOKU_9)
+    ref, _ = propagate(cand, SUDOKU_9, rules="extended")
+    if backend == "pallas":
+        got, _ = propagate_fixpoint_pallas(cand, SUDOKU_9, tile=8, rules="extended")
+    else:
+        got, _ = propagate_fixpoint_slices(cand, SUDOKU_9, rules="extended")
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_fixpoint_rejects_unknown_rules():
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+        propagate_fixpoint_pallas,
+        propagate_fixpoint_slices,
+    )
+
+    cand = encode_grid(jnp.asarray(np.stack([EASY_9]).astype(np.int32)), SUDOKU_9)
+    with pytest.raises(ValueError, match="rules"):
+        propagate_fixpoint_pallas(cand, SUDOKU_9, rules="extend")
+    with pytest.raises(ValueError, match="rules"):
+        propagate_fixpoint_slices(cand, SUDOKU_9, rules="extend")
