@@ -87,6 +87,12 @@ def build_solve_file_parser(sub) -> argparse.ArgumentParser:
     ap.add_argument("-n", "--size", type=int, default=9, help="board size n (9/16/25)")
     ap.add_argument("--batch", type=int, default=65536, help="boards per device batch")
     ap.add_argument("--search-lanes", type=int, default=32768)
+    ap.add_argument(
+        "--rules",
+        choices=("basic", "extended"),
+        default="basic",
+        help="propagation strength (extended adds box-line reductions)",
+    )
     return ap
 
 
@@ -105,7 +111,7 @@ def solve_file_main(args) -> None:
         args.output,
         geom,
         batch=args.batch,
-        bulk_config=BulkConfig(search_lanes=args.search_lanes),
+        bulk_config=BulkConfig(search_lanes=args.search_lanes, rules=args.rules),
     )
     stats["wall_s"] = round(time.perf_counter() - t0, 3)
     stats["boards_per_s"] = round(stats["total"] / max(stats["wall_s"], 1e-9), 1)
