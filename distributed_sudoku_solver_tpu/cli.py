@@ -44,6 +44,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--lanes", type=int, default=0, help="frontier lanes (0 = auto)")
     ap.add_argument("--stack-slots", type=int, default=64)
+    ap.add_argument(
+        "--rules",
+        choices=("basic", "extended"),
+        default="basic",
+        help="propagation strength (extended adds box-line reductions)",
+    )
+    ap.add_argument(
+        "--branch",
+        choices=("minrem", "first", "mixed"),
+        default="minrem",
+        help="branch heuristic (first = reference-order bit-exact DFS)",
+    )
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")
     ap.add_argument("--heartbeat-s", type=float, default=1.0)
@@ -60,7 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def make_engine(args) -> SolverEngine:
-    cfg = SolverConfig(lanes=args.lanes, stack_slots=args.stack_slots)
+    cfg = SolverConfig(
+        lanes=args.lanes,
+        stack_slots=args.stack_slots,
+        rules=args.rules,
+        branch=args.branch,
+    )
     solve_fn = None
     if args.sharded:
         from distributed_sudoku_solver_tpu.parallel import solve_batch_sharded
