@@ -77,6 +77,11 @@ def bench_propagation(jax, jnp, B: int) -> None:
         "pallas": chained(
             lambda c: propagate_fixpoint_pallas(c, SUDOKU_9, tile=2048)
         ),
+        "pallas_extended": chained(
+            lambda c: propagate_fixpoint_pallas(
+                c, SUDOKU_9, tile=2048, rules="extended"
+            )
+        ),
         "slices": chained(lambda c: propagate_fixpoint_slices(c, SUDOKU_9)),
         "boards_first_xla": chained(lambda c: propagate(c, SUDOKU_9)),
     }
