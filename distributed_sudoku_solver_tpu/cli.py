@@ -63,8 +63,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-dir",
         type=str,
         default=None,
-        help="capture a jax.profiler device trace for the node's lifetime "
+        help="capture a jax.profiler device trace into this dir "
         "(TensorBoard-compatible; SURVEY.md §5.1)",
+    )
+    ap.add_argument(
+        "--profile-secs",
+        type=float,
+        default=60.0,
+        help="bound the --profile-dir capture window (trace data grows "
+        "unboundedly on a long-lived node; 0 = whole lifetime)",
     )
     sub = ap.add_subparsers(dest="cmd", metavar="{solve-file}")
     build_solve_file_parser(sub)
@@ -103,11 +110,10 @@ def build_solve_file_parser(sub) -> argparse.ArgumentParser:
     ap.add_argument("-o", "--output", default=None, help="write solutions (line-aligned)")
     ap.add_argument("-n", "--size", type=int, default=9, help="board size n (9/16/25)")
     ap.add_argument("--batch", type=int, default=65536, help="boards per device batch")
-    ap.add_argument("--search-lanes", type=int, default=32768)
     ap.add_argument(
         "--rules",
         choices=("basic", "extended"),
-        default="basic",
+        default="extended",
         help="propagation strength (extended adds box-line reductions)",
     )
     return ap
@@ -128,7 +134,7 @@ def solve_file_main(args) -> None:
         args.output,
         geom,
         batch=args.batch,
-        bulk_config=BulkConfig(search_lanes=args.search_lanes, rules=args.rules),
+        bulk_config=BulkConfig(rules=args.rules),
     )
     stats["wall_s"] = round(time.perf_counter() - t0, 3)
     stats["boards_per_s"] = round(stats["total"] / max(stats["wall_s"], 1e-9), 1)
@@ -145,7 +151,27 @@ def main(argv=None) -> None:
     from distributed_sudoku_solver_tpu.utils.profiling import device_trace
 
     trace = device_trace(args.profile_dir) if args.profile_dir else contextlib.nullcontext()
-    with trace:  # try/finally semantics: the trace survives any exit path
+    with contextlib.ExitStack() as stack:
+        # try/finally semantics: the trace survives any exit path.  A bounded
+        # window (--profile-secs) stops capture without stopping the node —
+        # a lifetime-long trace grows without bound on a serving process.
+        stack.enter_context(trace)
+        if args.profile_dir and args.profile_secs > 0:
+            import threading
+
+            def _stop_trace():
+                import jax
+
+                try:
+                    jax.profiler.stop_trace()
+                    print(f"profile window closed ({args.profile_secs:g}s)")
+                except RuntimeError:
+                    pass  # already stopped (shutdown race)
+
+            timer = threading.Timer(args.profile_secs, _stop_trace)
+            timer.daemon = True
+            timer.start()
+            stack.callback(timer.cancel)
         engine = make_engine(args).start()
         node = ClusterNode(
             engine,

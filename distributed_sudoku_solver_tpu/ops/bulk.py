@@ -1,71 +1,76 @@
-"""Bulk solver: propagation-first pipeline for very large batches (DP at scale).
+"""Bulk solver: one-dispatch-per-chunk pipeline for very large batches (DP at scale).
 
 The throughput-oriented entry point — the workload the reference could only
 express as one HTTP `POST /solve` per puzzle per ring (SURVEY.md §2.2 "Data
-parallelism: NO — one puzzle at a time") becomes one call on a ``[B, n, n]``
-batch with B in the 10^5-10^6 range:
+parallelism: NO — one puzzle at a time") becomes a few device dispatches on
+``[B, n, n]`` batches with B in the 10^5-10^6 range.
 
-* **Stage 1 — propagate**: the whole batch runs the elimination +
-  hidden-singles fixpoint once.  On TPU this is the Pallas VMEM kernel
-  (``ops/pallas_propagate.py``), which is HBM-bandwidth-bound — each board
-  is read once and written once no matter how many sweeps it needs.  Most
-  easy/medium boards (e.g. the classic Kaggle 1M corpus) finish here with
-  zero search.
-* **Stage 2 — search the survivors**: boards still undecided are compacted
-  (host side — survivor counts are data-dependent, and XLA wants static
-  shapes) and fed through the lane-stack frontier engine
-  (``ops/frontier.py``) in VMEM-sized chunks.  JAX's async dispatch
-  pipelines chunk k+1's transfer against chunk k's compute.
+Design (round 2): the old two-stage pipeline (separate propagate pass, host
+compaction of survivors, then frontier search) spent more wall clock on
+host<->device round trips than on compute — each dispatch+fetch costs
+~100-150 ms through a tunneled device, and host-side survivor compaction
+forced a full sync between the stages.  Now each chunk is **one**
+``solve_batch`` dispatch: the frontier's first step *is* the propagation
+pass (boards that close under propagation resolve with zero branches and
+their lanes immediately become thieves for the hard ones), so the whole
+propagate -> classify -> search -> gang-up cascade happens in-graph with no
+host involvement.  Measured on a v5e chip this took the hard-mix corpus
+from 19.8k boards/s (round 1, two-stage) to ~101k boards/s.
 
-Contradictions found in stage 1 are reported as unsat without ever touching
-the search engine.
+Escalation rungs remain for the rare stragglers that overflow the shallow
+first-pass stack or hit the step cap: they re-run with OR-parallel thief
+gangs and deep stacks.  Chunks are dispatched ahead with a bounded in-flight
+window, so transfers overlap compute without holding the whole batch's
+device results live at once.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
-from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid, encode_grid
+from distributed_sudoku_solver_tpu.ops import wire
 from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
-from distributed_sudoku_solver_tpu.ops.propagate import board_status
-from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch_wire
 
 
 @dataclasses.dataclass(frozen=True)
 class BulkConfig:
     """Static bulk-pipeline configuration.
 
-    Stage-2 defaults come from a TPU v5e sweep (this session): survivor
-    throughput scales with chunk width up to ~32k lanes at 1 job/lane
-    (1.0k boards/s at 512 lanes -> 41.8k at 32768), so the first rung is
-    wide and shallow; deeper rungs re-run the rare stragglers that
-    overflow a shallow stack or hit the step cap.
+    Defaults come from a TPU v5e sweep (round 2): device-only throughput
+    rises with chunk width up to 65536 lanes (~101k boards/s on the
+    hard-mix corpus; wider shapes currently trip an XLA:TPU scatter-fusion
+    compiler CHECK), but end-to-end through a tunneled link 32768-board
+    chunks win (~81k vs ~57k boards/s) because chunk k+1's transfers
+    overlap chunk k's compute.  A 12-slot stack is deep enough that
+    first-pass overflow is rare on 9x9 while keeping the stack tensor at
+    ~128 MB per chunk.
     """
 
-    chunk: int = 65536  # stage-1 dispatch granularity (boards)
-    search_lanes: int = 32768  # rung-1 frontier width (jobs = lanes)
-    stack_slots: int = 16  # rung-1 DFS depth
+    chunk: int = 32768  # boards (= frontier lanes) per dispatch
+    stack_slots: int = 12  # first-pass DFS depth
     max_steps: int = 100_000
     max_sweeps: int = 64
-    propagator: Optional[str] = None  # stage 1; None = auto (pallas on TPU)
-    rules: str = "basic"  # 'extended' adds box-line reductions (all backends)
+    propagator: Optional[str] = None  # None = auto (slices on TPU, xla on CPU)
+    rules: str = "extended"  # box-line reductions close ~26% more boards
+    #   without search on hard-mix corpora; measured faster end-to-end
     # Escalation rungs for unresolved boards: (max jobs/chunk, lanes per job,
     # stack slots).  Wider-than-jobs lanes give straggler jobs an OR-parallel
     # gang of thief lanes; deep stacks make overflow impossible in practice.
     rungs: tuple = ((2048, 4, 64), (64, 64, 256))
+    inflight: int = 3  # dispatched-ahead chunks before draining the oldest
 
     def __post_init__(self) -> None:
         if self.propagator not in (None, "xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
         if self.rules not in ("basic", "extended"):
             raise ValueError(f"unknown rules {self.rules!r}")
+
 
 @dataclasses.dataclass
 class BulkResult:
@@ -75,90 +80,15 @@ class BulkResult:
     solved: np.ndarray  # bool[B]
     unsat: np.ndarray  # bool[B]
     by_propagation: np.ndarray  # bool[B]: solved with zero search
-    searched: int  # boards that went through stage 2
+    searched: int  # boards that needed at least one branch node
 
 
 def _auto_propagator() -> str:
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    # Boards-last slice sweeps win at wide lane counts on TPU; the CPU/test
+    # mesh prefers the boards-first loop (no transpose round-trips).
+    import jax
 
-
-def _to_wire_int8(grids: np.ndarray, geom: Geometry) -> np.ndarray:
-    """Narrow boards to int8 for the host->device link without weakening the
-    corrupt-input contract: anything outside [0, n] becomes -1, which
-    ``value_to_mask`` maps to the empty mask -> a clean unsat verdict (a
-    bare ``astype(int8)`` would *wrap* e.g. 257 into a legal-looking 1)."""
-    out = grids.astype(np.int8)
-    out[(grids < 0) | (grids > geom.n)] = -1
-    return out
-
-
-
-
-def _propagate_local(
-    cand: jax.Array, geom: Geometry, max_sweeps: int, propagator: str,
-    rules: str = "basic",
-) -> jax.Array:
-    if propagator == "pallas":
-        from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
-            propagate_fixpoint_pallas,
-        )
-
-        fixed, _ = propagate_fixpoint_pallas(cand, geom, max_sweeps, rules=rules)
-    elif propagator == "slices":
-        from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
-            propagate_fixpoint_slices,
-        )
-
-        fixed, _ = propagate_fixpoint_slices(cand, geom, max_sweeps, rules=rules)
-    elif propagator == "xla":
-        from distributed_sudoku_solver_tpu.ops.propagate import propagate
-
-        fixed, _ = propagate(cand, geom, max_sweeps, rules)
-    else:
-        raise ValueError(f"unknown propagator {propagator!r}")
-    return fixed
-
-
-def _sharded_propagator(geom, max_sweeps, propagator, rules, mesh):
-    from jax.sharding import PartitionSpec as P
-
-    (axis,) = mesh.axis_names
-    return jax.shard_map(
-        lambda c: _propagate_local(c, geom, max_sweeps, propagator, rules),
-        mesh=mesh,
-        in_specs=P(axis),
-        out_specs=P(axis),
-        check_vma=False,
-    )
-
-
-@functools.lru_cache(maxsize=None)
-def _stage1(geom: Geometry, max_sweeps: int, propagator: str, rules: str, mesh):
-    """One jitted program for a whole stage-1 chunk: encode -> fixpoint ->
-    status -> int8 decode.  A single device dispatch per chunk — running
-    the pre/post ops eagerly costs one host round-trip *per op* (~100 ms
-    each through a tunneled device; measured ~7 s/chunk, vs ~0.2 s fused).
-
-    Memoized (rebuilding the closure per chunk re-traces every call,
-    ~0.9 s/chunk measured) and keyed only on what stage 1 actually uses —
-    BulkConfigs differing in stage-2 fields share one compilation.
-    """
-
-    def run(chunk8: jax.Array):
-        cand = encode_grid(chunk8, geom)
-        if mesh is None:
-            fixed = _propagate_local(cand, geom, max_sweeps, propagator, rules)
-        else:
-            # Embarrassingly parallel over the mesh: each chip runs the
-            # fixpoint on its batch shard, no collectives (the caller pads
-            # chunks to a multiple of the mesh size with pre-solved boards).
-            fixed = _sharded_propagator(
-                geom, max_sweeps, propagator, rules, mesh
-            )(cand)
-        st = board_status(fixed, geom)
-        return decode_grid(fixed).astype(jnp.int8), st.solved, st.contradiction
-
-    return jax.jit(run)
+    return "slices" if jax.default_backend() == "tpu" else "xla"
 
 
 def solve_bulk(
@@ -169,14 +99,13 @@ def solve_bulk(
 ) -> BulkResult:
     """Solve ``grids`` int[B, n, n] (0 = empty); B may be huge.
 
-    Stage-1 chunks stream through the device back to host verdict arrays;
-    survivors are batched through the frontier engine.  Everything is
+    Each chunk is one device dispatch (propagation *and* search in-graph);
+    chunks are pipelined with a bounded in-flight window.  Everything is
     deterministic: results are independent of chunk sizes.
 
-    With ``mesh`` (a 1-axis ``jax.sharding.Mesh``), stage 1 shards the batch
-    over the chips (no collectives needed) and stage 2 runs the sharded
+    With ``mesh`` (a 1-axis ``jax.sharding.Mesh``), chunks run the sharded
     frontier (`parallel/sharded.py`: ring-``ppermute`` work stealing,
-    ``psum`` solution broadcast over ICI).
+    ``psum`` solution broadcast over ICI) with lanes sharded over the chips.
     """
     grids = np.ascontiguousarray(np.asarray(grids, dtype=np.int32))
     b, n, _ = grids.shape
@@ -185,58 +114,86 @@ def solve_bulk(
     solution = np.zeros((b, n, n), dtype=np.int32)
     solved = np.zeros(b, dtype=bool)
     unsat = np.zeros(b, dtype=bool)
+    branched = np.zeros(b, dtype=bool)
 
-    # --- stage 1: propagate every board to its fixpoint -------------------
     from distributed_sudoku_solver_tpu.utils.puzzles import solved_board
 
-    pending: list[tuple[int, jax.Array, jax.Array, jax.Array]] = []
-    for lo in range(0, b, config.chunk):
-        chunk = grids[lo : lo + config.chunk]
-        pad = (-len(chunk)) % n_dev
-        if pad:  # shard evenly; pre-solved pads are dropped on write-back
-            chunk = np.concatenate(
-                [chunk, np.tile(solved_board(geom)[None], (pad, 1, 1))]
-            )
-        # Boards cross the host<->device link as int8 (digits <= 35): 4x
-        # less transfer than int32 — on tunneled/remote setups the link and
-        # the per-dispatch round-trip, not the chip, bound bulk throughput.
-        prop = config.propagator or _auto_propagator()
-        stage1 = _stage1(geom, config.max_sweeps, prop, config.rules, mesh)
-        dec, st_solved, st_contra = stage1(
-            jnp.asarray(_to_wire_int8(chunk, geom))
-        )
-        k = len(chunk) - pad
-        pending.append((lo, dec[:k], st_solved[:k], st_contra[:k]))
-    for lo, dec, st_solved, st_contra in pending:
-        dec, st_solved, st_contra = (
-            np.asarray(dec),
-            np.asarray(st_solved),
-            np.asarray(st_contra),
-        )
-        hi = lo + dec.shape[0]
-        solution[lo:hi][st_solved] = dec[st_solved]
-        solved[lo:hi] = st_solved
-        unsat[lo:hi] = st_contra
-    by_propagation = solved.copy()
+    pad_board = solved_board(geom)
+    prop = config.propagator or _auto_propagator()
 
-    # --- stage 2: frontier-search the undecided remainder -----------------
-    survivors = np.flatnonzero(~solved & ~unsat)
-    searched = int(len(survivors))
-    # Frontier propagation backend: boards-last slice sweeps win at wide
-    # lane counts; at the deep rungs' narrow widths the boards-first loop
-    # fuses into VMEM anyway, so 'xla' avoids the transpose round-trips.
-    rungs = [(config.search_lanes, 1, config.stack_slots, "slices")] + [
-        (jobs, mult, slots, "xla") for jobs, mult, slots in config.rungs
-    ]
-    remaining = survivors
-    for max_jobs, lanes_per_job, slots, prop in rungs:
+    def run_chunk(batch: np.ndarray, scfg: SolverConfig):
+        # Wire format both directions (ops/wire.py): nibble-packed boards,
+        # single result array — one upload, one dispatch, one fetch.
+        packed = jnp.asarray(wire.pack_grids_host(batch, geom))
+        if mesh is not None:
+            from distributed_sudoku_solver_tpu.parallel.sharded import (
+                solve_batch_sharded_wire,
+            )
+
+            return solve_batch_sharded_wire(packed, geom, scfg, mesh)
+        return solve_batch_wire(packed, geom, scfg)
+
+    def pad_to(batch: np.ndarray, size: int) -> np.ndarray:
+        # Pad with an already-complete board: its lane resolves on step one
+        # and immediately turns thief, joining the OR-parallel gang on the
+        # real jobs — and one compiled shape serves every partial chunk.
+        if len(batch) == size:
+            return batch
+        pad = np.tile(pad_board[None], (size - len(batch), 1, 1))
+        return np.concatenate([batch, pad])
+
+    # --- first pass: every chunk is one dispatch --------------------------
+    # Size the frontier to the workload: a small batch must not dispatch a
+    # full-width (default 32k-lane) frontier of pad boards.  Power-of-two
+    # rounding keeps compiled shapes O(log) across call sites.
+    chunk = min(config.chunk, max(64, 1 << (max(b, 1) - 1).bit_length()))
+    chunk = max(n_dev, -(-chunk // n_dev) * n_dev)
+    first_cfg = SolverConfig(
+        lanes=chunk,
+        stack_slots=config.stack_slots,
+        max_steps=config.max_steps,
+        max_sweeps=config.max_sweeps,
+        propagator=prop,
+        rules=config.rules,
+    )
+
+    def drain(lo: int, res) -> None:
+        hi = min(lo + chunk, b)
+        k = hi - lo
+        r_sol, r_solved, r_unsat, r_branched = wire.unpack_result_host(
+            np.asarray(res), geom
+        )
+        r_sol, r_solved = r_sol[:k], r_solved[:k]
+        solution[lo:hi][r_solved] = r_sol[r_solved]
+        solved[lo:hi] = r_solved
+        unsat[lo:hi] = r_unsat[:k]
+        branched[lo:hi] = r_branched[:k]
+
+    pending: list[tuple[int, object]] = []
+    for lo in range(0, b, chunk):
+        batch = pad_to(grids[lo : lo + chunk], chunk)
+        pending.append((lo, run_chunk(batch, first_cfg)))
+        if len(pending) >= max(1, config.inflight):
+            drain(*pending.pop(0))
+    while pending:
+        drain(*pending.pop(0))
+
+    by_propagation = solved & ~branched
+    searched = int(branched.sum())
+
+    # --- escalation rungs: re-run unresolved stragglers with gangs --------
+    remaining = np.flatnonzero(~solved & ~unsat)
+    for max_jobs, lanes_per_job, slots in config.rungs:
         if len(remaining) == 0:
             break
         # Round the chunk up to a power of two (>= 64) so each rung compiles
         # O(log) distinct shapes across calls, not one per survivor count.
-        jobs_per_chunk = min(max_jobs, max(64, 1 << (len(remaining) - 1).bit_length()))
+        jobs_per_chunk = min(
+            max_jobs, max(64, 1 << (len(remaining) - 1).bit_length())
+        )
+        lanes = jobs_per_chunk * lanes_per_job
         scfg = SolverConfig(
-            min_lanes=jobs_per_chunk * lanes_per_job,
+            lanes=-(-lanes // n_dev) * n_dev,  # round up: lanes >= jobs always
             stack_slots=slots,
             max_steps=config.max_steps,
             max_sweeps=config.max_sweeps,
@@ -246,36 +203,21 @@ def solve_bulk(
             # steal pairing per step would ramp a gang up only linearly.
             steal_rounds=4 if lanes_per_job > 1 else 1,
         )
-        # Pad partial chunks with an already-complete board: its lane solves
-        # on step one and immediately turns thief, joining the OR-parallel
-        # gang on the real jobs (padding with a survivor copy would instead
-        # burn those lanes re-searching the hardest board).
-        pad_board = solved_board(geom)
         still: list[int] = []
         for lo in range(0, len(remaining), jobs_per_chunk):
             idx = remaining[lo : lo + jobs_per_chunk]
-            batch = grids[idx]
-            if len(idx) < jobs_per_chunk:  # keep one compiled shape per rung
-                pad = np.tile(pad_board[None], (jobs_per_chunk - len(idx), 1, 1))
-                batch = np.concatenate([batch, pad])
-            batch8 = jnp.asarray(_to_wire_int8(batch, geom))  # 4x less uplink
-            if mesh is not None:
-                from distributed_sudoku_solver_tpu.parallel.sharded import (
-                    solve_batch_sharded,
-                )
-
-                res = solve_batch_sharded(batch8, geom, scfg, mesh=mesh)
-            else:
-                res = solve_batch(batch8, geom, scfg)
-            # Device-side downcast so the downlink moves int8, not int32.
-            r_sol = np.asarray(res.solution.astype(jnp.int8))[: len(idx)]
-            r_solved = np.asarray(res.solved)[: len(idx)]
-            r_unsat = np.asarray(res.unsat)[: len(idx)]
+            res = run_chunk(pad_to(grids[idx], jobs_per_chunk), scfg)
+            r_sol, r_solved, r_unsat, _ = wire.unpack_result_host(
+                np.asarray(res), geom
+            )
+            r_sol, r_solved, r_unsat = (
+                r_sol[: len(idx)], r_solved[: len(idx)], r_unsat[: len(idx)],
+            )
             solution[idx] = np.where(r_solved[:, None, None], r_sol, 0)
             solved[idx] = r_solved
             unsat[idx] = r_unsat
             still.extend(idx[~r_solved & ~r_unsat])
-        remaining = np.asarray(still, dtype=survivors.dtype)
+        remaining = np.asarray(still, dtype=remaining.dtype)
 
     return BulkResult(
         solution=solution,

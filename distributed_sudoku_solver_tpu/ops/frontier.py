@@ -3,32 +3,44 @@
 TPU-native re-design of the reference's entire L2 scheduler (SURVEY.md §1,
 §2.1 #6/#7).  The mapping is one-to-one:
 
-* **lane = worker node.**  Each of L lanes owns a private DFS stack
-  ``stack[L, S, n, n]`` of partial boards (candidate bitmasks) with stack
-  pointer ``sp[L]`` — the reference's per-node recursion stack and
-  ``task_queue`` unified into one tensor.
-* **branch = the reference's guess loop.**  Each step, every live lane pops
-  its top board, propagates it to a fixpoint, and (if undecided) splits one
-  cell binarily: the *lowest candidate digit* (pushed on top, explored next —
+* **lane = worker node.**  Each of L lanes owns a working board ``top[L, h, w]``
+  (the state it is currently expanding) plus a private circular stack
+  ``stack[L, S, h, w]`` of deferred sibling subtrees — the reference's
+  per-node recursion stack and ``task_queue`` unified into device tensors.
+* **branch = the reference's guess loop.**  Each step, every live lane
+  propagates its top to a fixpoint and (if undecided) splits one cell
+  binarily: the *lowest candidate digit* becomes the new top (explored next —
   exact ascending-digit DFS order, ``/root/reference/DHT_Node.py:522``)
-  vs. *the rest* (left underneath).  All lanes branch in lockstep: one
-  ``lax.while_loop`` iteration advances every lane.
+  while *the rest* is pushed onto the stack.  All lanes branch in lockstep:
+  one ``lax.while_loop`` iteration advances every lane.
 * **work stealing = the NEEDWORK handshake, tensorized.**  Idle lanes
-  (empty stack, or their job already solved) are matched each step with the
-  richest lanes, and steal the *bottom* stack entry — the shallowest node,
-  i.e. the largest unexplored subtree, the moral equivalent of the
-  reference's ``split_array_in_middle`` shipping half the guess range
+  (no working board, or their job already solved) are matched each step with
+  working lanes and steal the *bottom* stack row — the shallowest node, i.e.
+  the largest unexplored subtree, the moral equivalent of the reference's
+  ``split_array_in_middle`` shipping half the guess range
   (``/root/reference/DHT_Node.py:499-510``, ``utils.py:1-9``).  No
-  messages, no idle chip while any lane has depth >= 2.
+  messages, no idle lane while any lane has deferred work.
 * **speculative cancellation = the SOLUTION_FOUND purge, in-graph.**  Lanes
   whose job is solved are cleared by a mask (``/root/reference/
   DHT_Node.py:358-387``) and immediately become thieves for other jobs.
 
-Per-lane LIFO makes progress unconditional (pop 1, push <= 2 per step), so
-unlike a flat expansion pool the frontier cannot deadlock at capacity; a
-stack that would overflow S drops its *rest* sibling and records the loss
-per job (``overflowed``), downgrading a would-be "unsat" verdict to
-"unknown" rather than ever reporting wrongly.
+Hot-loop design notes (this file is the single-chip performance core):
+
+* The per-lane stack is **circular** (``base``/``count`` pointers), so a
+  bottom-steal is a pointer bump — never a shift of the whole stack tensor.
+* Every stack access is **row-granular** (one ``[L, h, w]`` gather or
+  scatter per step); the full ``[L, S, h, w]`` tensor is never rewritten.
+  The previous design's full-stack ``where`` masks and shift were ~2/3 of
+  the measured step cost at L=32k.
+* Thief/donor pairing is **prefix-sum rank matching** (two ``cumsum``s and
+  O(L) scatters), not ``argsort`` — sorting 32k lanes per step cost more
+  than the propagation fixpoint itself.
+
+Per-lane LIFO makes progress unconditional (each live lane consumes exactly
+one node per step), so unlike a flat expansion pool the frontier cannot
+deadlock at capacity; a stack that would overflow S drops its *rest*
+sibling and records the loss per job (``overflowed``), downgrading a
+would-be "unsat" verdict to "unknown" rather than ever reporting wrongly.
 
 The engine is generic over the problem family (``ops/csp.py``): states are
 opaque ``uint32[h, w]`` tensors, and propagation / classification /
@@ -53,7 +65,7 @@ class SolverConfig:
 
     lanes: int = 0  # total lanes; 0 = auto: max(n_jobs, min_lanes)
     min_lanes: int = 64  # speculation width floor for small job counts
-    stack_slots: int = 64  # DFS stack depth per lane
+    stack_slots: int = 64  # deferred-sibling stack depth per lane
     max_steps: int = 100_000  # branch rounds before giving up
     max_sweeps: int = 64  # propagation sweeps per fixpoint (Sudoku adapter)
     branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref
@@ -79,8 +91,11 @@ class SolverConfig:
 class Frontier(NamedTuple):
     """Loop-carried device state for one solve call."""
 
-    stack: jax.Array  # uint32[L, S, h, w] problem states
-    sp: jax.Array  # int32[L] stack pointer (0 = empty lane)
+    top: jax.Array  # uint32[L, h, w] working state per lane (inert if !has_top)
+    has_top: jax.Array  # bool[L] lane holds a working state
+    stack: jax.Array  # uint32[L, S, h, w] deferred siblings (circular buffer)
+    base: jax.Array  # int32[L] bottom slot of the circular stack
+    count: jax.Array  # int32[L] deferred rows on the stack
     job: jax.Array  # int32[L] owning job; -1 = unassigned
     solved: jax.Array  # bool[J]
     solution: jax.Array  # uint32[J, h, w] (solved problem state)
@@ -104,16 +119,25 @@ def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
     n_jobs, h, w = states0.shape
     n_lanes = config.resolve_lanes(n_jobs)
     s = config.stack_slots
-    seed_lane = (jnp.arange(n_jobs, dtype=jnp.int32) * n_lanes) // n_jobs
-    stack = jnp.zeros((n_lanes, s, h, w), jnp.uint32)
-    stack = stack.at[seed_lane, 0].set(states0.astype(jnp.uint32))
-    sp = jnp.zeros(n_lanes, jnp.int32).at[seed_lane].set(1)
+    # Host-side int64: j * L overflows int32 beyond ~46k lanes (shapes are
+    # static, so this is free at trace time).
+    import numpy as np
+
+    seed_lane = jnp.asarray(
+        (np.arange(n_jobs, dtype=np.int64) * n_lanes) // n_jobs, jnp.int32
+    )
+    top = jnp.zeros((n_lanes, h, w), jnp.uint32)
+    top = top.at[seed_lane].set(states0.astype(jnp.uint32))
+    has_top = jnp.zeros(n_lanes, bool).at[seed_lane].set(True)
     job = jnp.full(n_lanes, -1, jnp.int32).at[seed_lane].set(
         jnp.arange(n_jobs, dtype=jnp.int32)
     )
     return Frontier(
-        stack=stack,
-        sp=sp,
+        top=top,
+        has_top=has_top,
+        stack=jnp.zeros((n_lanes, s, h, w), jnp.uint32),
+        base=jnp.zeros(n_lanes, jnp.int32),
+        count=jnp.zeros(n_lanes, jnp.int32),
         job=job,
         solved=jnp.zeros(n_jobs, bool),
         solution=jnp.zeros((n_jobs, h, w), jnp.uint32),
@@ -126,50 +150,67 @@ def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
     )
 
 
+def _rank_of(mask: jax.Array) -> jax.Array:
+    """int32[L]: 0-based rank of each True lane among the True lanes."""
+    return jnp.cumsum(mask.astype(jnp.int32)) - 1
+
+
+def _lane_by_rank(mask: jax.Array, n_lanes: int) -> jax.Array:
+    """int32[L]: lane index of the r-th True lane (n_lanes where r >= popcount)."""
+    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
+    rank = jnp.where(mask, _rank_of(mask), n_lanes)
+    return jnp.full(n_lanes, n_lanes, jnp.int32).at[rank].set(
+        lane_idx, mode="drop"
+    )
+
+
 def _steal(
-    stack: jax.Array, sp: jax.Array, job: jax.Array, job_live: jax.Array
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Match idle lanes with the richest lanes; move each donor's *bottom* row.
+    top: jax.Array,
+    has_top: jax.Array,
+    stack: jax.Array,
+    base: jax.Array,
+    count: jax.Array,
+    job: jax.Array,
+    job_live: jax.Array,
+):
+    """Match idle lanes with working lanes; hand each thief a donor's *bottom* row.
 
     Receiver-initiated like the reference's NEEDWORK (``/root/reference/
-    DHT_Node.py:246-254``); donors are served richest-first so the deepest
-    backlogs drain first, and each donor serves at most one thief per step.
+    DHT_Node.py:246-254``).  Pairing is k-th idle lane with k-th donor lane
+    (both in lane order) via prefix-sum ranks — O(L) scatters, no sorting;
+    each donor serves at most one thief per round.  The stolen row goes
+    straight into the thief's ``top``, and the donor's bottom pointer bumps:
+    no stack data moves on the donor side at all.
     """
-    n_lanes = sp.shape[0]
+    n_lanes, s = stack.shape[:2]
     lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
 
-    idle = sp == 0
-    donor = (sp >= 2) & job_live
-    # Thieves in lane order; donors richest-first.  argsort is a permutation,
-    # so donors are distinct; pair k-th thief with k-th donor.
-    thief_order = jnp.argsort(jnp.where(idle, lane_idx, n_lanes + lane_idx))
-    donor_order = jnp.argsort(jnp.where(donor, -sp, jnp.int32(1)), stable=True)
+    idle = ~has_top
+    donor = has_top & (count >= 1) & job_live
     n_pairs = jnp.minimum(jnp.sum(idle), jnp.sum(donor)).astype(jnp.int32)
-    pair = lane_idx < n_pairs
 
-    thief_lane = jnp.where(pair, thief_order, n_lanes)  # OOB -> dropped
-    donor_lane = jnp.where(pair, donor_order, n_lanes)
+    thief_of = _lane_by_rank(idle, n_lanes)  # rank -> thief lane
+    donor_of = _lane_by_rank(donor, n_lanes)  # rank -> donor lane
+    pair = lane_idx < n_pairs  # rank axis
+    thief_lane = jnp.where(pair, thief_of, n_lanes)  # OOB -> dropped
+    donor_lane = jnp.where(pair, donor_of, n_lanes)
+    safe_donor = jnp.clip(donor_lane, 0, n_lanes - 1)
 
-    stolen = stack[jnp.clip(donor_lane, 0, n_lanes - 1), 0]
-    stolen_job = job[jnp.clip(donor_lane, 0, n_lanes - 1)]
+    stolen = stack[safe_donor, base[safe_donor] % s]
+    top = top.at[thief_lane].set(stolen, mode="drop")
+    has_top = has_top.at[thief_lane].set(pair, mode="drop")
+    job = job.at[thief_lane].set(job[safe_donor], mode="drop")
 
-    # Thieves: bottom row becomes their whole stack.
-    stack = stack.at[thief_lane, 0].set(stolen, mode="drop")
-    sp = sp.at[thief_lane].set(jnp.where(pair, 1, 0), mode="drop")
-    job = job.at[thief_lane].set(stolen_job, mode="drop")
-
-    # Donors: shift their stack down one slot.
     donor_sel = jnp.zeros(n_lanes, bool).at[donor_lane].set(pair, mode="drop")
-    shifted = jnp.concatenate([stack[:, 1:], stack[:, -1:]], axis=1)
-    stack = jnp.where(donor_sel[:, None, None, None], shifted, stack)
-    sp = jnp.where(donor_sel, sp - 1, sp)
-    return stack, sp, job, n_pairs
+    base = jnp.where(donor_sel, (base + 1) % s, base)
+    count = jnp.where(donor_sel, count - 1, count)
+    return top, has_top, base, count, job, n_pairs
 
 
 def frontier_step(
     state: Frontier, problem: CSProblem, config: SolverConfig
 ) -> Frontier:
-    """One lockstep round: pop+propagate tops -> harvest/cancel -> branch -> steal."""
+    """One lockstep round: propagate tops -> harvest/cancel -> branch/pop -> steal."""
     n_lanes, s = state.stack.shape[:2]
     n_jobs = state.solved.shape[0]
     lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
@@ -177,13 +218,11 @@ def frontier_step(
     # Lanes whose job resolved are cleared (the SOLUTION_FOUND purge).
     job_safe = jnp.clip(state.job, 0, n_jobs - 1)
     job_live = (state.job >= 0) & ~state.solved[job_safe]
-    sp = jnp.where(job_live, state.sp, 0)
-    live = sp > 0
+    live = state.has_top & job_live
+    count = jnp.where(job_live, state.count, 0)
 
     # --- L0: propagate every live top to a fixpoint -------------------------
-    top_idx = jnp.clip(sp - 1, 0, s - 1)
-    tops = state.stack[lane_idx, top_idx]
-    tops = jnp.where(live[:, None, None], tops, 0)  # idle tops are inert zeros
+    tops = jnp.where(live[:, None, None], state.top, 0)  # idle tops are inert
     tops, sweeps = problem.propagate(tops)
     top_solved, top_contra = problem.status(tops)
     solved_tops = top_solved & live
@@ -200,49 +239,56 @@ def frontier_step(
     solution = jnp.where(newly[:, None, None], sol_rows, state.solution)
     solved = state.solved | newly
 
-    # --- branch: replace parent with `rest`, push `guess` on top ------------
+    # --- branch: guess becomes the new top, `rest` is pushed ----------------
     guess, rest = problem.branch(tops)
 
-    full_stack = sp >= s
-    push = undecided & ~full_stack
-    # On overflow: keep DFS-ing the guess in place; the rest-subtree is lost.
-    in_place = jnp.where(
-        undecided[:, None, None], jnp.where(push[:, None, None], rest, guess), tops
-    )
-    slot = jnp.arange(s, dtype=jnp.int32)[None, :]
-    at_top = slot == top_idx[:, None]
-    at_push = slot == sp[:, None]
-    stack = jnp.where(
-        (undecided[:, None] & at_top)[:, :, None, None], in_place[:, None], state.stack
-    )
-    stack = jnp.where(
-        (push[:, None] & at_push)[:, :, None, None], guess[:, None], stack
-    )
-    sp = sp + push.astype(jnp.int32) - (solved_tops | contra_tops).astype(jnp.int32)
+    can_push = undecided & (count < s)
+    push_slot = (state.base + count) % s
+    stack = state.stack.at[
+        jnp.where(can_push, lane_idx, n_lanes), jnp.clip(push_slot, 0, s - 1)
+    ].set(rest, mode="drop")
 
-    overflow_now = undecided & full_stack
+    # On overflow: keep DFS-ing the guess in place; the rest-subtree is lost.
+    overflow_now = undecided & ~can_push
     overflowed = state.overflowed.at[
         jnp.where(overflow_now, state.job, n_jobs)
     ].set(True, mode="drop")
-
     nodes = state.nodes.at[jnp.where(undecided, state.job, n_jobs)].add(
         jnp.where(undecided, jnp.int32(1), jnp.int32(0)), mode="drop"
     )
 
+    # --- resolved lanes pop their next deferred sibling ---------------------
+    resolved = solved_tops | contra_tops
+    can_pop = resolved & (count > 0)
+    pop_slot = (state.base + count - 1) % s
+    popped = state.stack[lane_idx, jnp.clip(pop_slot, 0, s - 1)]
+
+    top = jnp.where(undecided[:, None, None], guess, state.top)
+    top = jnp.where(can_pop[:, None, None], popped, top)
+    has_top = state.has_top & job_live & ~(resolved & ~can_pop)
+    count = count + can_push.astype(jnp.int32) - can_pop.astype(jnp.int32)
+
     # --- work stealing ------------------------------------------------------
     job_live = (state.job >= 0) & ~solved[job_safe]
-    sp = jnp.where(job_live, sp, 0)
+    has_top = has_top & job_live
+    count = jnp.where(job_live, count, 0)
+    base = state.base
     n_steals = jnp.int32(0)
     job_arr = state.job
     if config.steal:
         for _ in range(max(1, config.steal_rounds)):
-            stack, sp, job_arr, k = _steal(stack, sp, job_arr, job_live)
+            top, has_top, base, count, job_arr, k = _steal(
+                top, has_top, stack, base, count, job_arr, job_live
+            )
             job_live = (job_arr >= 0) & ~solved[jnp.clip(job_arr, 0, n_jobs - 1)]
             n_steals = n_steals + k
 
     return Frontier(
+        top=top,
+        has_top=has_top,
         stack=stack,
-        sp=sp,
+        base=base,
+        count=count,
         job=job_arr,
         solved=solved,
         solution=solution,
@@ -259,7 +305,7 @@ def frontier_live(state: Frontier) -> jax.Array:
     """bool[L]: lanes still holding unexplored work for an unsolved job."""
     n_jobs = state.solved.shape[0]
     job_safe = jnp.clip(state.job, 0, n_jobs - 1)
-    return (state.sp > 0) & (state.job >= 0) & ~state.solved[job_safe]
+    return state.has_top & (state.job >= 0) & ~state.solved[job_safe]
 
 
 def run_frontier(

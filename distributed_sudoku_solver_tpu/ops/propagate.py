@@ -170,24 +170,27 @@ def box_line_sweep(cand: jax.Array, geom: Geometry) -> jax.Array:
         # [..., v, h] -> [..., v, 1, h]: broadcast the confined-bit mask over r
         point = seg & jnp.swapaxes((p_once & ~p_twice)[..., None], -1, -2)
         # eliminate `point` bits from the same global row in *other* boxes:
-        # OR over boxes h' != h, unrolled over the small nh axis.
+        # OR over boxes h' != h, unrolled over the small nh axis.  With one
+        # box per row (nh == 1) there is no "other" box — the rule is
+        # vacuous, like the Mosaic twin's guard (_box_line_dir).
         point_other = jnp.zeros_like(seg)
         for h in range(nh):
             others = [point[..., h2] for h2 in range(nh) if h2 != h]
-            acc = others[0]
-            for o in others[1:]:
+            acc = jnp.zeros_like(seg[..., 0])
+            for o in others:
                 acc = acc | o
             point_other = point_other.at[..., h].set(acc)
 
         # claiming: bits in exactly one box of the row (v, r)
         c_once, c_twice = once_twice_reduce(seg, -1)
         claim = seg & (c_once & ~c_twice)[..., None]
-        # eliminate `claim` bits from other box-rows of the same box.
+        # eliminate `claim` bits from other box-rows of the same box (vacuous
+        # when bh == 1: a box one row tall has no other box-row).
         claim_other = jnp.zeros_like(seg)
         for r in range(bh):
             others = [claim[..., r2, :] for r2 in range(bh) if r2 != r]
-            acc = others[0]
-            for o in others[1:]:
+            acc = jnp.zeros_like(seg[..., 0, :])
+            for o in others:
                 acc = acc | o
             claim_other = claim_other.at[..., r, :].set(acc)
 

@@ -109,6 +109,28 @@ def solve_batch(
     return _finalize(state)
 
 
+@functools.partial(jax.jit, static_argnames=("geom", "config"))
+def solve_batch_wire(
+    packed: jax.Array, geom: Geometry, config: SolverConfig = SolverConfig()
+) -> jax.Array:
+    """Wire-format solve: packed grids in, packed solution + verdicts out.
+
+    One upload, one dispatch, one download per chunk — the bulk pipeline's
+    hot entry on tunneled devices, where every extra fetch costs a ~120 ms
+    round trip and every byte moves at ~10 MB/s (``ops/wire.py``).
+    """
+    from distributed_sudoku_solver_tpu.ops import wire
+
+    grids = wire.unpack_grids_device(packed, geom)
+    cand0 = encode_grid(grids, geom)
+    state = init_frontier(cand0, config)
+    state = run_frontier(state, sudoku_csp(geom, config), config)
+    res = _finalize(state)
+    return wire.pack_result_device(
+        res.solution, res.solved, res.unsat, res.nodes > 0, geom
+    )
+
+
 def solve_one(grid, geom: Geometry, config: SolverConfig = SolverConfig()):
     """Convenience: solve a single board; returns (np solution | None, SolveResult)."""
     grids = jnp.asarray(np.asarray(grid)[None])

@@ -1,0 +1,119 @@
+"""Host<->device wire packing for the bulk path.
+
+The reference's wire problem was a 1,024-byte UDP recv cap truncating 25x25
+TASK pickles (``/root/reference/DHT_Node.py:94``, SURVEY.md §2.5 #8).  The
+TPU build's equivalent constraint is the *host<->device link*: on tunneled
+devices (axon RPC) the measured link runs at ~10-15 MB/s with ~120 ms per
+round trip, so at 10^5-board batches the transfer — not the chip — bounds
+end-to-end throughput.  Two countermeasures, both transparent to callers:
+
+* **nibble packing** (geometries with n <= 14): 4 bits per cell, two cells
+  per byte, halving both directions vs int8 cells.  The spare code point 15
+  marks corrupt input (out-of-range host values), which the mask encoder
+  maps to the empty candidate mask -> a clean unsat verdict, preserving the
+  corrupt-input contract of ``value_to_mask`` (``ops/bitmask.py:49-60``).
+* **single-fetch results**: solution cells and the per-board verdict
+  (solved / unsat / branched bits) ride one device array, so a chunk costs
+  one upload, one dispatch, one download — each extra fetch is a full
+  tunnel round trip (~120 ms) regardless of size.
+
+Formats (chosen statically by geometry):
+
+* ``nibble`` (n <= 14): grids ``uint8[B, ceil(n²/2)]``; results
+  ``uint8[B, ceil(n²/2) + 1]`` (cells then verdict byte).
+* ``byte`` (n > 14): grids ``int8[B, n²]`` (corrupt -> -1); results
+  ``int8[B, n² + 1]``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+
+NIBBLE_MAX_N = 14  # 15 is the corrupt marker, so digits must stay <= 14
+
+VERDICT_SOLVED = 1
+VERDICT_UNSAT = 2
+VERDICT_BRANCHED = 4
+
+
+def uses_nibbles(geom: Geometry) -> bool:
+    return geom.n <= NIBBLE_MAX_N
+
+
+def grid_wire_width(geom: Geometry) -> int:
+    n2 = geom.n * geom.n
+    return (n2 + 1) // 2 if uses_nibbles(geom) else n2
+
+
+def pack_grids_host(grids: np.ndarray, geom: Geometry) -> np.ndarray:
+    """int grids [B, n, n] -> wire bytes (numpy, host side)."""
+    b = grids.shape[0]
+    flat = np.ascontiguousarray(grids).reshape(b, -1).astype(np.int64)
+    bad = (flat < 0) | (flat > geom.n)
+    if not uses_nibbles(geom):
+        out = flat.astype(np.int8)
+        out[bad] = -1
+        return out
+    cells = np.where(bad, 15, flat).astype(np.uint8)
+    if cells.shape[1] % 2:
+        cells = np.concatenate([cells, np.zeros((b, 1), np.uint8)], axis=1)
+    return cells[:, 0::2] | (cells[:, 1::2] << 4)
+
+
+def unpack_grids_device(packed: jnp.ndarray, geom: Geometry) -> jnp.ndarray:
+    """Wire bytes -> int32 grids [B, n, n] (traced, device side)."""
+    b = packed.shape[0]
+    n2 = geom.n * geom.n
+    if not uses_nibbles(geom):
+        return packed.astype(jnp.int32).reshape(b, geom.n, geom.n)
+    u = packed.astype(jnp.uint8)
+    cells = jnp.stack([u & 15, u >> 4], axis=-1).reshape(b, -1)[:, :n2]
+    return cells.astype(jnp.int32).reshape(b, geom.n, geom.n)
+
+
+def pack_result_device(
+    solution: jnp.ndarray,
+    solved: jnp.ndarray,
+    unsat: jnp.ndarray,
+    branched: jnp.ndarray,
+    geom: Geometry,
+) -> jnp.ndarray:
+    """(solution int[B,n,n], verdict bools[B]) -> one wire array (traced)."""
+    b = solution.shape[0]
+    verdict = (
+        solved.astype(jnp.uint8) * VERDICT_SOLVED
+        | unsat.astype(jnp.uint8) * VERDICT_UNSAT
+        | branched.astype(jnp.uint8) * VERDICT_BRANCHED
+    )
+    flat = solution.reshape(b, -1)
+    if not uses_nibbles(geom):
+        return jnp.concatenate(
+            [flat.astype(jnp.int8), verdict.astype(jnp.int8)[:, None]], axis=1
+        )
+    cells = flat.astype(jnp.uint8)
+    if cells.shape[1] % 2:
+        cells = jnp.concatenate([cells, jnp.zeros((b, 1), jnp.uint8)], axis=1)
+    packed = cells[:, 0::2] | (cells[:, 1::2] << 4)
+    return jnp.concatenate([packed, verdict[:, None]], axis=1)
+
+
+def unpack_result_host(wire: np.ndarray, geom: Geometry):
+    """Wire result -> (solution int32[B,n,n], solved, unsat, branched) (host)."""
+    wire = np.asarray(wire)
+    b = wire.shape[0]
+    n2 = geom.n * geom.n
+    verdict = wire[:, -1].astype(np.uint8)
+    cells = wire[:, :-1]
+    if uses_nibbles(geom):
+        u = cells.astype(np.uint8)
+        cells = np.stack([u & 15, u >> 4], axis=-1).reshape(b, -1)[:, :n2]
+    solution = cells.astype(np.int32).reshape(b, geom.n, geom.n)
+    return (
+        solution,
+        (verdict & VERDICT_SOLVED) > 0,
+        (verdict & VERDICT_UNSAT) > 0,
+        (verdict & VERDICT_BRANCHED) > 0,
+    )

@@ -377,8 +377,11 @@ def _solve_banded_jit(
     state = init_frontier(cand, config)
     board = P(None, None, axis, None)  # stack[L, S, rows, n]: rows sharded
     specs = Frontier(
+        top=P(None, axis, None),  # top[L, rows, n]: rows sharded
+        has_top=P(),
         stack=board,
-        sp=P(),
+        base=P(),
+        count=P(),
         job=P(),
         solved=P(),
         solution=P(None, axis, None),

@@ -45,6 +45,7 @@ from distributed_sudoku_solver_tpu.ops.csp import CSProblem
 from distributed_sudoku_solver_tpu.ops.frontier import (
     Frontier,
     SolverConfig,
+    _lane_by_rank,
     frontier_live,
     frontier_step,
     init_frontier,
@@ -59,59 +60,63 @@ from distributed_sudoku_solver_tpu.parallel.mesh import LANE_AXIS, default_mesh
 
 
 def _ring_steal(
+    top: jax.Array,
+    has_top: jax.Array,
     stack: jax.Array,
-    sp: jax.Array,
+    base: jax.Array,
+    count: jax.Array,
     job: jax.Array,
     job_live: jax.Array,
     axis: str,
     k: int,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Ship up to ``k`` bottom rows from this chip to its ring successor.
+):
+    """Ship up to ``k`` bottom stack rows from this chip to its ring successor.
 
     Receiver-initiated and work-conserving: the successor first advertises its
     idle-lane count, the donor ships ``min(request, donors, k)`` rows and
-    deletes exactly those, and the receiver installs every row it gets (its
-    idle count cannot have shrunk in between — nothing else touches it).
+    deletes exactly those (a circular-buffer bottom bump — no stack data
+    moves donor-side), and the receiver installs every row it gets straight
+    into idle lanes' working tops (its idle count cannot have shrunk in
+    between — the local steal already ran this step, nothing else touches it).
     """
     n_dev = jax.lax.axis_size(axis)
-    n_lanes = stack.shape[0]
+    n_lanes, s = stack.shape[:2]
     k = min(k, n_lanes)
-    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
     slot_k = jnp.arange(k, dtype=jnp.int32)
 
     fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]  # donor -> successor
     back = [(i, (i - 1) % n_dev) for i in range(n_dev)]  # request travels back
 
-    idle = sp == 0
+    idle = ~has_top
     n_idle = jnp.sum(idle).astype(jnp.int32)
     request = jax.lax.ppermute(n_idle, axis, back)  # my successor's idle count
 
-    donor = (sp >= 2) & job_live
-    donor_order = jnp.argsort(jnp.where(donor, -sp, jnp.int32(1)), stable=True)
+    donor = has_top & (count >= 1) & job_live
+    donor_of = _lane_by_rank(donor, n_lanes)
     n_send = jnp.minimum(jnp.minimum(request, jnp.sum(donor)), k).astype(jnp.int32)
     take = slot_k < n_send
-    donor_lane = jnp.where(take, donor_order[:k], n_lanes)
+    donor_lane = jnp.where(take, donor_of[:k], n_lanes)
     safe_donor = jnp.clip(donor_lane, 0, n_lanes - 1)
-    boards = jnp.where(take[:, None, None], stack[safe_donor, 0], 0)
+    boards = jnp.where(
+        take[:, None, None], stack[safe_donor, base[safe_donor] % s], 0
+    )
     jobs = jnp.where(take, job[safe_donor], -1)
 
-    # Remove shipped bottoms: donors shift their stacks down one slot.
     donor_sel = jnp.zeros(n_lanes, bool).at[donor_lane].set(take, mode="drop")
-    shifted = jnp.concatenate([stack[:, 1:], stack[:, -1:]], axis=1)
-    stack = jnp.where(donor_sel[:, None, None, None], shifted, stack)
-    sp = jnp.where(donor_sel, sp - 1, sp)
+    base = jnp.where(donor_sel, (base + 1) % s, base)
+    count = jnp.where(donor_sel, count - 1, count)
 
     boards_in = jax.lax.ppermute(boards, axis, fwd)
     jobs_in = jax.lax.ppermute(jobs, axis, fwd)
     n_in = jax.lax.ppermute(n_send, axis, fwd)
 
     install = slot_k < n_in
-    thief_order = jnp.argsort(jnp.where(idle, lane_idx, n_lanes + lane_idx))
-    thief_lane = jnp.where(install, thief_order[:k], n_lanes)
-    stack = stack.at[thief_lane, 0].set(boards_in, mode="drop")
-    sp = sp.at[thief_lane].set(jnp.where(install, 1, 0), mode="drop")
+    thief_of = _lane_by_rank(idle, n_lanes)
+    thief_lane = jnp.where(install, thief_of[:k], n_lanes)
+    top = top.at[thief_lane].set(boards_in, mode="drop")
+    has_top = has_top.at[thief_lane].set(install, mode="drop")
     job = job.at[thief_lane].set(jobs_in, mode="drop")
-    return stack, sp, job, n_in
+    return top, has_top, base, count, job, n_in
 
 
 def _sharded_step(
@@ -141,20 +146,25 @@ def _sharded_step(
     overflowed = jax.lax.psum(st.overflowed.astype(jnp.int32), axis) > 0
 
     # --- cross-chip work rebalance (NEEDWORK over the ICI ring) -------------
-    stack, sp, job = st.stack, st.sp, st.job
+    top, has_top, base, count, job = st.top, st.has_top, st.base, st.count, st.job
     steals = st.steals
     if n_dev > 1 and config.steal and config.ring_steal_k > 0:
         job_safe = jnp.clip(job, 0, n_jobs - 1)
         job_live = (job >= 0) & ~solved[job_safe]
-        sp = jnp.where(job_live, sp, 0)
-        stack, sp, job, shipped = _ring_steal(
-            stack, sp, job, job_live, axis, config.ring_steal_k
+        has_top = has_top & job_live
+        count = jnp.where(job_live, count, 0)
+        top, has_top, base, count, job, shipped = _ring_steal(
+            top, has_top, st.stack, base, count, job, job_live,
+            axis, config.ring_steal_k,
         )
         steals = steals + shipped
 
     return Frontier(
-        stack=stack,
-        sp=sp,
+        top=top,
+        has_top=has_top,
+        stack=st.stack,
+        base=base,
+        count=count,
         job=job,
         solved=solved,
         solution=solution,
@@ -218,8 +228,11 @@ def _solve_csp_sharded_jit(
     state = init_frontier(states0, cfg)
 
     lane_specs = Frontier(
+        top=P(axis),
+        has_top=P(axis),
         stack=P(axis),
-        sp=P(axis),
+        base=P(axis),
+        count=P(axis),
         job=P(axis),
         solved=P(),
         solution=P(),
@@ -285,3 +298,17 @@ def solve_batch_sharded(
     """Solve int grids [J, n, n] with lanes sharded over every chip in ``mesh``."""
     mesh = mesh if mesh is not None else default_mesh()
     return _solve_sharded_jit(jnp.asarray(grids), geom, config, mesh)
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config", "mesh"))
+def solve_batch_sharded_wire(
+    packed: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
+) -> jax.Array:
+    """Wire-format sharded solve (see ``ops/solve.solve_batch_wire``)."""
+    from distributed_sudoku_solver_tpu.ops import wire
+
+    grids = wire.unpack_grids_device(packed, geom)
+    res = _solve_sharded_jit(grids, geom, config, mesh)
+    return wire.pack_result_device(
+        res.solution, res.solved, res.unsat, res.nodes > 0, geom
+    )

@@ -137,34 +137,120 @@ def solve_file(
     geom: Geometry,
     batch: int = 65536,
     bulk_config=None,
+    resume: bool = True,
 ):
     """Solve every board in a file; returns aggregate stats.
 
     With ``out_path``, solutions are written line-aligned with the input
     (unsolved lines all-zeros), streamed batch-by-batch to a temp file and
     atomically renamed — peak memory stays O(batch) end to end.
+
+    **Crash-resumable** (the reference re-solves everything after any crash;
+    here a sidecar ``{out_path}.progress`` records boards done, output bytes
+    flushed, and running stats after every batch).  A rerun with ``resume``
+    truncates the partial output to the last recorded byte, skips the
+    already-solved boards, and appends — producing a byte-identical file to
+    an uninterrupted run (solves are deterministic).  Both sidecars are
+    removed on success.
+
+    Stats: ``unresolved`` counts boards that exhausted every escalation rung
+    (possible at 16x16/25x25 with tight ``max_steps``) — they end neither
+    solved nor unsat and are written as all-zero lines, indistinguishable
+    from unsat lines in the output file, so only this count exposes them.
     """
+    import hashlib
+    import json
+
     from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
 
     cfg = bulk_config or BulkConfig()
-    total = solved = unsat = searched = 0
-    tmp = f"{out_path}.{os.getpid()}.tmp" if out_path else None
-    out_f = open(tmp, "wb") if tmp else None
+    stats = {"total": 0, "solved": 0, "unsat": 0, "searched": 0}
+    tmp = f"{out_path}.partial" if out_path else None
+    prog_path = f"{out_path}.progress" if out_path else None
+
+    # A progress sidecar only matches a run with the same input file (head
+    # hash + size), geometry, batch and solver config — resuming someone
+    # else's sidecar would silently splice two runs into one output file.
+    run_sig = None
+    if tmp:
+        st = os.stat(in_path)
+        with open(in_path, "rb") as f:
+            head = hashlib.sha256(f.read(65536)).hexdigest()[:16]
+        run_sig = json.dumps(
+            {
+                "input": [head, st.st_size],
+                "geom": [geom.box_h, geom.box_w],
+                "batch": batch,
+                "config": repr(cfg),
+            }
+        )
+
+    # Open-then-lock-then-decide: the single lock holder makes every
+    # truncate/resume decision, so concurrent runs cannot interleave.
+    skip = 0
+    out_f = open(tmp, "ab") if tmp else None
+    if out_f is not None:
+        # One writer per output path: resume needs a stable partial-file
+        # name, so concurrent runs would otherwise interleave appends.
+        # flock releases on crash; a second writer fails loudly instead.
+        import fcntl
+
+        try:
+            fcntl.flock(out_f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            out_f.close()
+            raise RuntimeError(
+                f"another solve_file run is writing {out_path!r} "
+                f"(lock on {tmp!r} is held)"
+            ) from None
+        prog = None
+        if resume and os.path.exists(prog_path):
+            with open(prog_path) as pf:
+                prog = json.load(pf)
+        if prog is not None and prog.get("run_sig") == run_sig:
+            skip = int(prog["boards_done"])
+            stats.update(prog["stats"])
+            out_f.truncate(int(prog["bytes_done"]))  # drop post-record bytes
+        else:  # fresh run, or stale sidecar from a different input/config
+            out_f.truncate(0)
+        out_f.seek(0, os.SEEK_END)
     try:
         for boards in iter_board_batches(in_path, geom, batch):
+            if skip >= len(boards):  # already solved in the interrupted run
+                skip -= len(boards)
+                continue
+            if skip:
+                boards = boards[skip:]
+                skip = 0
             res = solve_bulk(boards, geom, cfg)
-            total += len(boards)
-            solved += int(res.solved.sum())
-            unsat += int(res.unsat.sum())
-            searched += res.searched
+            stats["total"] += len(boards)
+            stats["solved"] += int(res.solved.sum())
+            stats["unsat"] += int(res.unsat.sum())
+            stats["searched"] += res.searched
             if out_f:
                 out_f.write(_format_lines(res.solution))
+                out_f.flush()
+                os.fsync(out_f.fileno())
+                ptmp = f"{prog_path}.tmp"
+                with open(ptmp, "w") as pf:
+                    json.dump(
+                        {
+                            "run_sig": run_sig,
+                            "boards_done": stats["total"],
+                            "bytes_done": out_f.tell(),
+                            "stats": stats,
+                        },
+                        pf,
+                    )
+                os.replace(ptmp, prog_path)
         if out_f:
             out_f.close()
             out_f = None
             os.replace(tmp, out_path)
+            if os.path.exists(prog_path):
+                os.unlink(prog_path)
     finally:
         if out_f:
-            out_f.close()
-            os.unlink(tmp)
-    return {"total": total, "solved": solved, "unsat": unsat, "searched": searched}
+            out_f.close()  # keep tmp + progress: the next run resumes them
+    stats["unresolved"] = stats["total"] - stats["solved"] - stats["unsat"]
+    return stats

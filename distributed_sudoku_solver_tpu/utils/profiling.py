@@ -38,7 +38,10 @@ def device_trace(logdir: str) -> Iterator[None]:
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError:
+            pass  # already stopped (bounded --profile-secs window fired)
 
 
 class StatWindow:

@@ -15,7 +15,7 @@ def _corpus(n_gen=12, n_clues=30):
 
 def test_bulk_solves_everything_and_validates():
     grids = _corpus()
-    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8, search_lanes=32))
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8))
     assert res.solved.all() and not res.unsat.any()
     for g, s in zip(grids, res.solution):
         assert is_valid_solution(s)
@@ -27,8 +27,8 @@ def test_bulk_solves_everything_and_validates():
 
 def test_bulk_chunking_is_invisible():
     grids = _corpus(n_gen=6)
-    a = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=4, search_lanes=16))
-    b = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=64, search_lanes=64))
+    a = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=4))
+    b = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=64))
     np.testing.assert_array_equal(a.solution, b.solution)
     np.testing.assert_array_equal(a.solved, b.solved)
 
@@ -36,7 +36,7 @@ def test_bulk_chunking_is_invisible():
 def test_bulk_reports_unsat():
     bad = np.stack([EASY_9, EASY_9]).astype(np.int32)
     bad[1, 0, 2] = 5  # row already holds a 5 -> contradiction
-    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2, search_lanes=16))
+    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2))
     assert res.solved[0] and not res.solved[1]
     assert res.unsat[1]
     assert solve_oracle(bad[1]) is None
@@ -44,7 +44,7 @@ def test_bulk_reports_unsat():
 
 def test_bulk_matches_oracle_solution_on_unique_puzzles():
     grids = puzzle_batch(SUDOKU_9, 4, seed=33, n_clues=28).astype(np.int32)
-    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=4, search_lanes=16))
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=4))
     assert res.solved.all()
     for g, s in zip(grids, res.solution):
         np.testing.assert_array_equal(s, solve_oracle(g))
@@ -57,8 +57,8 @@ def test_bulk_sharded_matches_single_device():
 
     grids = _corpus(n_gen=8)
     mesh = make_mesh(jax.devices())
-    a = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8, search_lanes=32))
-    s = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8, search_lanes=32), mesh=mesh)
+    a = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8))
+    s = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8), mesh=mesh)
     np.testing.assert_array_equal(a.solved, s.solved)
     assert s.solved.all()
     for g, sol in zip(grids, s.solution):
@@ -73,12 +73,12 @@ def test_bulk_sharded_ragged_chunk_pads_evenly():
 
     grids = _corpus(n_gen=1)[:5]  # 5 boards over 8 devices: pad path
     mesh = make_mesh(jax.devices())
-    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=16, search_lanes=32), mesh=mesh)
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=16), mesh=mesh)
     assert res.solved.all() and len(res.solved) == 5
 
 
 def test_corrupt_values_stay_unsat_through_int8_wire():
     bad = np.stack([EASY_9, EASY_9]).astype(np.int32)
     bad[1, 0, 0] = 257  # would wrap to a legal-looking 1 via a bare int8 cast
-    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2, search_lanes=16))
+    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2))
     assert res.solved[0] and not res.solved[1] and res.unsat[1]

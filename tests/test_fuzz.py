@@ -40,7 +40,7 @@ def _random_boards(seed: int, count: int, keep_lo=0.3, keep_hi=0.9):
 @pytest.mark.parametrize("seed", [1, 2, 3])
 def test_bulk_verdicts_match_oracle_on_random_boards(seed):
     grids = _random_boards(seed, 24)
-    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=24, search_lanes=64))
+    res = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=24))
     for i, g in enumerate(grids):
         oracle_sol = solve_oracle(g)
         if res.solved[i]:
