@@ -90,17 +90,12 @@ def make_engine(args) -> SolverEngine:
         from distributed_sudoku_solver_tpu.parallel import solve_batch_sharded
 
         solve_fn = lambda grids, geom, c: solve_batch_sharded(grids, geom, c)  # noqa: E731
-    engine = SolverEngine(config=cfg, max_batch=args.max_batch, solve_fn=solve_fn)
-    if args.handicap:
-        inner = engine._solve_fn
-        delay = args.handicap / 1000.0
-
-        def slow(grids, geom, c):
-            time.sleep(delay)
-            return inner(grids, geom, c)
-
-        engine._solve_fn = slow
-    return engine
+    return SolverEngine(
+        config=cfg,
+        max_batch=args.max_batch,
+        solve_fn=solve_fn,
+        handicap_s=args.handicap / 1000.0,
+    )
 
 
 def build_solve_file_parser(sub) -> argparse.ArgumentParser:

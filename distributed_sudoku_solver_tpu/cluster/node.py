@@ -2,8 +2,12 @@
 
 Host-level re-design of the reference's overlay layer (SURVEY.md §1 L3,
 §2.1 #8-#10) for the TPU world: each *node* is a host driving its own chip
-mesh (the data plane lives in ``parallel/``), and the cluster layer moves
-whole jobs, not subtrees — intra-job parallelism is the mesh's business.
+mesh (the data plane lives in ``parallel/``).  Jobs are placed whole at
+submit time (least-outstanding), and *live* jobs are additionally split
+mid-flight: an idle node's NEEDWORK pulls bottom stack rows — the largest
+unexplored subtrees — out of a busy peer's running frontier, exactly the
+reference's dynamic guess-range split (``/root/reference/DHT_Node.py:
+491-510``) lifted to the host tier.
 
 Capability map (reference -> here):
 
@@ -20,22 +24,28 @@ Capability map (reference -> here):
   self-promotes (exactly one detector per corpse, so promotion is unique).
 * re-execution from the delegator's ledger (``:47,497,509,201-209``) ->
   every forwarded job stays in ``self._ledger`` until its SOLUTION arrives;
-  when a member leaves the network view, its ledger entries re-run locally.
-* NEEDWORK load balancing (``:246-254``) -> receiver-independent
-  least-outstanding dispatch at submit time (jobs are sized uniformly by
-  the engine's batching, so proactive balance replaces reactive stealing
-  at this layer; reactive stealing lives on-device, ``ops/frontier.py``).
-* STATS_REQ 1 s gather sleep (``:566-598``) -> synchronous request/reply
+  workers stream PROGRESS snapshots (their surviving subtree roots) back to
+  the origin, so when a member dies its jobs *resume mid-subtree* from the
+  last snapshot instead of restarting — strictly stronger than the
+  reference's recompute-from-ledger.
+* NEEDWORK work stealing (``:246-254,491-510``) -> an idle node NEEDWORKs
+  its ring predecessor; the busy peer sheds bottom stack rows from its
+  neediest live job (``serving/engine.shed_work``) and ships them as a
+  SUBTASK; first-win cancellation and unsat-aggregation across the parts
+  are handled by the per-job execution aggregate (:class:`_Exec`).
+* STATS_REQ 1 s gather sleep (``:566-598``) -> parallel request/reply
   fan-out with per-peer timeouts.
 """
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import socket
 import threading
 import time
-from typing import Optional
+import uuid as uuid_mod
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -55,12 +65,155 @@ def local_ip() -> str:
         return "127.0.0.1"
 
 
+def pack_rows(rows: np.ndarray) -> dict:
+    """Subtree roots (uint32 candidate masks) -> JSON-safe wire payload.
+
+    Little-endian raw bytes under base64: the same rows that
+    ``utils/checkpoint.py`` snapshots to npz, so the checkpoint format and
+    the offload/progress wire format are one representation.
+    """
+    r = np.ascontiguousarray(np.asarray(rows, dtype="<u4"))
+    return {
+        "shape": list(r.shape),
+        "data": base64.b64encode(r.tobytes()).decode("ascii"),
+    }
+
+
+def unpack_rows(d: dict) -> np.ndarray:
+    shape = tuple(int(x) for x in d["shape"])
+    raw = base64.b64decode(d["data"])
+    rows = np.frombuffer(raw, dtype="<u4").reshape(shape)
+    return rows.astype(np.uint32)
+
+
 @dataclasses.dataclass(frozen=True)
 class ClusterConfig:
     heartbeat_s: float = 1.0
     fail_factor: float = 3.0  # declare dead after fail_factor * heartbeat_s
     io_timeout_s: float = 5.0
     stats_timeout_s: float = 2.0
+    # Mid-job offload + progress checkpointing:
+    needwork: bool = True  # idle nodes pull subtree work from the ring
+    shed_k: int = 8  # max stack rows shipped per SUBTASK
+    progress_interval_s: float = 0.5  # worker -> origin snapshot cadence
+    progress_max_rows: int = 4096  # skip snapshots larger than this
+
+
+class _Exec:
+    """One uuid's execution on this node: local engine job + shed parts.
+
+    Finalization rules (the distributed counterpart of ``SolveResult``):
+
+    * solved   — the local search or *any* part solves (first win; losers
+                 are cancelled, the speculative-cancellation contract of
+                 ``/root/reference/DHT_Node.py:348-387``);
+    * unsat    — the local space is exhausted (nothing dropped, nothing
+                 still shipped) AND every part reports its subspace
+                 exhausted: the disjoint parts cover the job's space, so
+                 exhaustion composes into a proof;
+    * cancelled/error — propagate immediately, cancelling live parts;
+    * nodes    — accumulate across the local run, all parts, and any
+                 resumed predecessor (``base_nodes``).
+    """
+
+    def __init__(
+        self,
+        node: "ClusterNode",
+        job: Job,
+        on_final: Callable[[dict], None],
+        base_nodes: int = 0,
+    ):
+        self.node = node
+        self.uuid = job.uuid
+        self.job = job
+        self.on_final = on_final
+        self.base_nodes = base_nodes
+        self.parts: dict[str, dict] = {}  # part_uuid -> {peer, done, exhausted, nodes}
+        self.finalized = False
+        self.lock = threading.Lock()
+        threading.Thread(
+            target=self._watch_local, daemon=True, name=f"exec-{self.uuid[:8]}"
+        ).start()
+
+    def _watch_local(self) -> None:
+        self.job.done.wait()
+        self._maybe_finalize()
+
+    def add_part(self, part_uuid: str, peer: str) -> bool:
+        with self.lock:
+            if self.finalized:
+                return False
+            self.parts[part_uuid] = {
+                "peer": peer,
+                "done": False,
+                "exhausted": False,
+                "nodes": 0,
+            }
+            return True
+
+    def on_part_result(self, part_uuid: str, msg: dict) -> None:
+        with self.lock:
+            info = self.parts.get(part_uuid)
+            if info is None or info["done"]:
+                return
+            info["done"] = True
+            info["exhausted"] = bool(msg.get("unsat"))
+            info["nodes"] = int(msg.get("nodes", 0))
+        if msg.get("solved") and msg.get("solution") is not None:
+            self._finalize(
+                solved=True, solution=np.asarray(msg["solution"], dtype=np.int32)
+            )
+            self.node.engine.cancel(self.uuid)  # stop the local loser
+        else:
+            self._maybe_finalize()
+
+    def _maybe_finalize(self) -> None:
+        job = self.job
+        if not job.done.is_set():
+            return  # local still running; parts alone conclude only via solve
+        if job.solved:
+            self._finalize(solved=True, solution=job.solution)
+            return
+        if job.cancelled:
+            self._finalize(cancelled=True)
+            return
+        if job.error:
+            self._finalize(error=job.error)
+            return
+        with self.lock:
+            if any(not p["done"] for p in self.parts.values()):
+                return  # exhausted locally, but shipped subtrees still out
+            all_parts_exhausted = all(p["exhausted"] for p in self.parts.values())
+        self._finalize(unsat=job.exhausted and all_parts_exhausted)
+
+    def _finalize(
+        self,
+        solved: bool = False,
+        solution=None,
+        unsat: bool = False,
+        cancelled: bool = False,
+        error: Optional[str] = None,
+    ) -> None:
+        with self.lock:
+            if self.finalized:
+                return
+            self.finalized = True
+            part_nodes = sum(p["nodes"] for p in self.parts.values())
+            losers = [
+                (pu, p["peer"]) for pu, p in self.parts.items() if not p["done"]
+            ]
+        for part_uuid, peer in losers:
+            self.node._send_cancel(peer, part_uuid)
+        self.on_final(
+            {
+                "solved": solved,
+                "solution": solution,
+                "unsat": unsat,
+                "cancelled": cancelled,
+                "error": error,
+                "nodes": self.base_nodes + int(self.job.nodes) + part_nodes,
+            }
+        )
 
 
 class ClusterNode:
@@ -105,9 +258,13 @@ class ClusterNode:
         self.net_term: int = 0
         self.net_epoch: int = 0
         self._last_hb = time.monotonic()
-        self._ledger: dict[str, dict] = {}  # uuid -> {grid, member, job}
+        self._ledger: dict[str, dict] = {}  # uuid -> {grid, member, job, rows?, nodes_done?}
+        self._execs: dict[str, _Exec] = {}  # uuid -> live local execution
+        self._parts: dict[str, str] = {}  # part_uuid -> root uuid (parts run here)
         self._outstanding: dict[str, int] = {}  # member -> in-flight count
         self._rr = 0
+        self.subtasks_sent = 0
+        self.subtasks_run = 0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -205,6 +362,17 @@ class ClusterNode:
                 )
             except WireError:
                 pass  # successor's own detector handles its death
+            # Receiver-initiated stealing (``DHT_Node.py:246-248``): idle ->
+            # ask my ring predecessor for a slice of a live search.
+            if self.config.needwork and self.engine.busy_depth() == 0:
+                try:
+                    wire.send_msg(
+                        wire.parse_addr(pred),
+                        {"method": "NEEDWORK", "addr": self.addr_s},
+                        self.config.io_timeout_s,
+                    )
+                except WireError:
+                    pass
             limit = self.config.heartbeat_s * self.config.fail_factor
             with self._lock:
                 expired = time.monotonic() - self._last_hb > limit
@@ -235,7 +403,15 @@ class ClusterNode:
         elif method == "SOLUTION":
             self._on_solution(msg)
         elif method == "CANCEL":
-            self.engine.cancel(msg["uuid"])
+            self._on_cancel(msg["uuid"])
+        elif method == "NEEDWORK":
+            self._on_needwork(msg["addr"])
+        elif method == "SUBTASK":
+            self._on_subtask(msg)
+        elif method == "PART_RESULT":
+            self._on_part_result(msg)
+        elif method == "PROGRESS":
+            self._on_progress(msg)
         elif method == "STATS_REQ":
             s = self.engine.stats()
             wire.reply_msg(
@@ -352,6 +528,63 @@ class ClusterNode:
             self._last_hb = time.monotonic()
         self._on_node_failed(dead)
 
+    # -- local execution (engine + shed parts) -------------------------------
+    def _start_exec(
+        self,
+        on_final: Callable[[dict], None],
+        grid: Optional[np.ndarray] = None,
+        roots: Optional[np.ndarray] = None,
+        geom=None,
+        job_uuid: Optional[str] = None,
+        base_nodes: int = 0,
+    ) -> _Exec:
+        """Run a job (or subtree part) on the local engine under an _Exec
+        aggregate; ``on_final`` fires exactly once with the merged result."""
+        if roots is not None:
+            ej = self.engine.submit_roots(roots, geom, job_uuid=job_uuid)
+        else:
+            ej = self.engine.submit(grid, job_uuid=job_uuid)
+
+        def wrapped(result: dict) -> None:
+            with self._lock:
+                self._execs.pop(ej.uuid, None)
+            on_final(result)
+
+        ex = _Exec(self, ej, wrapped, base_nodes=base_nodes)
+        with self._lock:
+            self._execs[ej.uuid] = ex
+        return ex
+
+    def _apply_result(self, handle: Job, r: dict) -> None:
+        handle.solved = bool(r["solved"])
+        handle.unsat = bool(r["unsat"])
+        handle.nodes = int(r["nodes"])
+        handle.cancelled = bool(r["cancelled"])
+        handle.error = r["error"]
+        if r["solution"] is not None:
+            handle.solution = np.asarray(r["solution"], dtype=np.int32)
+        handle.done.set()
+
+    def _send_cancel(self, peer: str, job_uuid: str) -> None:
+        if peer == self.addr_s:
+            self._on_cancel(job_uuid)
+            return
+        try:
+            wire.send_msg(
+                wire.parse_addr(peer),
+                {"method": "CANCEL", "uuid": job_uuid},
+                self.config.io_timeout_s,
+            )
+        except WireError:
+            pass
+
+    def _on_cancel(self, job_uuid: str) -> None:
+        self.engine.cancel(job_uuid)
+        with self._lock:
+            parts = [p for p, root in self._parts.items() if root == job_uuid]
+        for p in parts:
+            self.engine.cancel(p)
+
     # -- job dispatch --------------------------------------------------------
     def submit(self, grid) -> Job:
         g = np.asarray(grid, dtype=np.int32)
@@ -363,18 +596,11 @@ class ClusterNode:
         return self._submit_remote(g, member)
 
     def cancel(self, job_uuid: str) -> None:
-        self.engine.cancel(job_uuid)
+        self._on_cancel(job_uuid)
         with self._lock:
             entry = self._ledger.get(job_uuid)
         if entry is not None:
-            try:
-                wire.send_msg(
-                    wire.parse_addr(entry["member"]),
-                    {"method": "CANCEL", "uuid": job_uuid},
-                    self.config.io_timeout_s,
-                )
-            except WireError:
-                pass
+            self._send_cancel(entry["member"], job_uuid)
 
     def _pick_member(self) -> str:
         """Least-outstanding member; ties broken round-robin (load balance)."""
@@ -394,13 +620,17 @@ class ClusterNode:
             self._outstanding[member] = self._outstanding.get(member, 0) + delta
 
     def _submit_local(self, g: np.ndarray) -> Job:
-        job = self.engine.submit(g)
+        geom = geometry_for_size(g.shape[0])
+        ju = str(uuid_mod.uuid4())
+        handle = Job(uuid=ju, grid=g, geom=geom)
         self._track(self.addr_s, +1)
-        threading.Thread(
-            target=lambda: (job.done.wait(), self._track(self.addr_s, -1)),
-            daemon=True,
-        ).start()
-        return job
+
+        def fin(r: dict) -> None:
+            self._track(self.addr_s, -1)
+            self._apply_result(handle, r)
+
+        self._start_exec(fin, grid=g, job_uuid=ju)
+        return handle
 
     def _submit_remote(self, g: np.ndarray, member: str) -> Job:
         geom = geometry_for_size(g.shape[0])
@@ -426,43 +656,55 @@ class ClusterNode:
         return job
 
     def _reexecute(self, job_uuid: str) -> None:
+        """Re-run a job whose worker left the network view.
+
+        If the worker streamed PROGRESS snapshots, resume from its surviving
+        subtree roots (skipping everything already searched) and carry its
+        nodes counter; otherwise restart from the clue grid, like the
+        reference's ledger re-queue (``DHT_Node.py:201-209``).
+        """
         with self._lock:
             entry = self._ledger.pop(job_uuid, None)
         if entry is None:
             return
         self._track(entry["member"], -1)
         handle: Job = entry["job"]
-        local = self.engine.submit(entry["grid"], job_uuid=job_uuid)
         self._track(self.addr_s, +1)
 
-        def relay():
-            local.done.wait()
+        def fin(r: dict) -> None:
             self._track(self.addr_s, -1)
-            handle.solution = local.solution
-            handle.solved = local.solved
-            handle.unsat = local.unsat
-            handle.nodes = local.nodes
-            handle.cancelled = local.cancelled
-            handle.error = local.error
-            handle.done.set()
+            self._apply_result(handle, r)
 
-        threading.Thread(target=relay, daemon=True).start()
+        rows_packed = entry.get("rows")
+        if rows_packed is not None:
+            rows = unpack_rows(rows_packed)
+            geom = geometry_for_size(rows.shape[1])
+            self._start_exec(
+                fin,
+                roots=rows,
+                geom=geom,
+                job_uuid=job_uuid,
+                base_nodes=int(entry.get("nodes_done", 0)),
+            )
+        else:
+            self._start_exec(fin, grid=entry["grid"], job_uuid=job_uuid)
 
     def _on_task(self, msg: dict) -> None:
         grid = np.asarray(msg["grid"], dtype=np.int32)
         origin = msg["origin"]
-        job = self.engine.submit(grid, job_uuid=msg["uuid"])
+        ju = msg["uuid"]
 
-        def reply():
-            job.done.wait()
+        def fin(r: dict) -> None:
             payload = {
                 "method": "SOLUTION",
-                "uuid": job.uuid,
-                "solved": job.solved,
-                "unsat": job.unsat,
-                "nodes": job.nodes,
-                "error": job.error,
-                "solution": job.solution.tolist() if job.solution is not None else None,
+                "uuid": ju,
+                "solved": r["solved"],
+                "unsat": r["unsat"],
+                "nodes": r["nodes"],
+                "error": r["error"],
+                "solution": r["solution"].tolist()
+                if r["solution"] is not None
+                else None,
             }
             try:
                 wire.send_msg(
@@ -471,7 +713,128 @@ class ClusterNode:
             except WireError:
                 pass  # origin died; its successor's repair already re-executed
 
-        threading.Thread(target=reply, daemon=True).start()
+        ex = self._start_exec(fin, grid=grid, job_uuid=ju)
+        if self.config.progress_interval_s > 0:
+            threading.Thread(
+                target=self._progress_loop,
+                args=(ex, origin),
+                daemon=True,
+                name=f"progress-{ju[:8]}",
+            ).start()
+
+    def _progress_loop(self, ex: _Exec, origin: str) -> None:
+        """Stream the job's surviving subtree roots to its origin so a death
+        here resumes mid-subtree there (SURVEY.md §5.4's promise)."""
+        while not self._stop.is_set() and not ex.finalized:
+            time.sleep(self.config.progress_interval_s)
+            if ex.finalized:
+                return
+            snap = self.engine.snapshot_rows(ex.uuid, timeout=2.0)
+            if snap is None:
+                continue
+            rows, nodes, shed_parts = snap
+            # Coverage gate: sheds and snapshots are serviced by the same
+            # device-loop thread, so shed_parts==0 *at the cut* proves these
+            # rows cover the job's entire remaining space.  Once anything
+            # has been shed, stop streaming — the origin keeps the last
+            # full-coverage snapshot (checking ex.parts here instead would
+            # race the shed that _on_needwork runs before add_part).
+            if shed_parts > 0:
+                return
+            if rows.shape[0] > self.config.progress_max_rows:
+                continue
+            try:
+                wire.send_msg(
+                    wire.parse_addr(origin),
+                    {
+                        "method": "PROGRESS",
+                        "uuid": ex.uuid,
+                        "rows": pack_rows(rows),
+                        "nodes": int(nodes) + ex.base_nodes,
+                    },
+                    self.config.io_timeout_s,
+                )
+            except WireError:
+                return  # origin unreachable; repair will reassign anyway
+
+    def _on_progress(self, msg: dict) -> None:
+        with self._lock:
+            entry = self._ledger.get(msg["uuid"])
+            if entry is not None:
+                entry["rows"] = msg["rows"]
+                entry["nodes_done"] = int(msg["nodes"])
+
+    # -- mid-job offload (NEEDWORK -> SUBTASK -> PART_RESULT) ----------------
+    def _on_needwork(self, requester: str) -> None:
+        if requester == self.addr_s:
+            return
+        shed = self.engine.shed_work(k=self.config.shed_k, timeout=2.0)
+        if shed is None:
+            return  # nothing worth splitting (reference: no task, no range > 1)
+        root_uuid, rows = shed
+        with self._lock:
+            ex = self._execs.get(root_uuid)
+        part_uuid = f"{root_uuid}#p{time.monotonic_ns()}"
+        if ex is None or not ex.add_part(part_uuid, requester):
+            return  # job resolved while we were shedding; rows are moot
+        payload = {
+            "method": "SUBTASK",
+            "part": part_uuid,
+            "root": root_uuid,
+            "rows": pack_rows(rows),
+            "report_to": self.addr_s,
+        }
+        try:
+            wire.send_msg(
+                wire.parse_addr(requester), payload, self.config.io_timeout_s
+            )
+            self.subtasks_sent += 1
+        except WireError:
+            # Requester vanished between NEEDWORK and now: run the part
+            # ourselves so the shed subtrees are never lost.
+            self._on_subtask(payload)
+
+    def _on_subtask(self, msg: dict) -> None:
+        rows = unpack_rows(msg["rows"])
+        part_uuid = msg["part"]
+        root_uuid = msg["root"]
+        report_to = msg["report_to"]
+        geom = geometry_for_size(rows.shape[1])
+        with self._lock:
+            self._parts[part_uuid] = root_uuid
+        self.subtasks_run += 1
+
+        def fin(r: dict) -> None:
+            with self._lock:
+                self._parts.pop(part_uuid, None)
+            payload = {
+                "method": "PART_RESULT",
+                "part": part_uuid,
+                "root": root_uuid,
+                "solved": r["solved"],
+                "unsat": r["unsat"],
+                "nodes": r["nodes"],
+                "solution": r["solution"].tolist()
+                if r["solution"] is not None
+                else None,
+            }
+            if report_to == self.addr_s:
+                self._on_part_result(payload)
+                return
+            try:
+                wire.send_msg(
+                    wire.parse_addr(report_to), payload, self.config.io_timeout_s
+                )
+            except WireError:
+                pass  # shedder died; the origin's repair path re-covers this
+
+        self._start_exec(fin, roots=rows, geom=geom, job_uuid=part_uuid)
+
+    def _on_part_result(self, msg: dict) -> None:
+        with self._lock:
+            ex = self._execs.get(msg["root"])
+        if ex is not None:
+            ex.on_part_result(msg["part"], msg)
 
     def _on_solution(self, msg: dict) -> None:
         with self._lock:
@@ -490,26 +853,44 @@ class ClusterNode:
 
     # -- views (HTTP layer) --------------------------------------------------
     def stats_view(self) -> dict:
-        """Reference `/stats` shape (``DHT_Node.py:573-586``), sleep-free."""
+        """Reference `/stats` shape (``DHT_Node.py:573-586``), sleep-free.
+
+        Per-peer requests run in parallel with individual timeouts, so a
+        degraded cluster costs one timeout, not O(N) serial timeouts."""
         s = self.engine.stats()
         nodes = [{"address": self.addr_s, "validations": s["validations"]}]
         total_v, total_s = s["validations"], s["solved"]
         with self._lock:
             peers = [m for m in self.network if m != self.addr_s]
-        for m in peers:
+        results: list[Optional[dict]] = [None] * len(peers)
+
+        def ask(i: int, m: str) -> None:
             try:
-                res = wire.request(
+                results[i] = wire.request(
                     wire.parse_addr(m),
                     {"method": "STATS_REQ"},
                     self.config.stats_timeout_s,
                 )
+            except WireError:
+                results[i] = None
+
+        threads = [
+            threading.Thread(target=ask, args=(i, m), daemon=True)
+            for i, m in enumerate(peers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.config.stats_timeout_s + 1.0)
+        for m, res in zip(peers, results):
+            if res is None:
+                nodes.append({"address": m, "validations": None})
+            else:
                 nodes.append(
                     {"address": res["address"], "validations": res["validations"]}
                 )
                 total_v += res["validations"]
                 total_s += res["solved"]
-            except WireError:
-                nodes.append({"address": m, "validations": None})
         return {"all": {"solved": total_s, "validations": total_v}, "nodes": nodes}
 
     def network_view(self) -> dict:

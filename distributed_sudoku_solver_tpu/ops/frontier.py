@@ -150,6 +150,168 @@ def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
     )
 
 
+def init_frontier_roots(
+    roots: jax.Array, job_of_root: jax.Array, n_jobs: int, config: SolverConfig
+) -> Frontier:
+    """Seed a frontier from R root states, each tagged with an owning job.
+
+    Generalizes :func:`init_frontier`: a *resumed* or *offloaded* job
+    re-enters the search as the disjunction of its surviving subtree roots
+    (candidate-mask states extracted from a previous frontier), not as its
+    original clue grid — the TPU heir of the reference shipping its current
+    partially-filled grid + guess range to a thief
+    (``/root/reference/DHT_Node.py:502-509``).  Roots whose ``job_of_root``
+    is -1 are padding and leave their lane idle (an immediate thief).
+    """
+    n_roots, h, w = roots.shape
+    n_lanes = config.resolve_lanes(n_roots)
+    import numpy as np
+
+    seed_lane = jnp.asarray(
+        (np.arange(n_roots, dtype=np.int64) * n_lanes) // n_roots, jnp.int32
+    )
+    valid = job_of_root >= 0
+    lane_t = jnp.where(valid, seed_lane, n_lanes)  # invalid -> dropped scatter
+    top = jnp.zeros((n_lanes, h, w), jnp.uint32).at[lane_t].set(
+        roots.astype(jnp.uint32), mode="drop"
+    )
+    has_top = jnp.zeros(n_lanes, bool).at[lane_t].set(True, mode="drop")
+    job = jnp.full(n_lanes, -1, jnp.int32).at[lane_t].set(job_of_root, mode="drop")
+    s = config.stack_slots
+    return Frontier(
+        top=top,
+        has_top=has_top,
+        stack=jnp.zeros((n_lanes, s, h, w), jnp.uint32),
+        base=jnp.zeros(n_lanes, jnp.int32),
+        count=jnp.zeros(n_lanes, jnp.int32),
+        job=job,
+        solved=jnp.zeros(n_jobs, bool),
+        solution=jnp.zeros((n_jobs, h, w), jnp.uint32),
+        overflowed=jnp.zeros(n_jobs, bool),
+        nodes=jnp.zeros(n_jobs, jnp.int32),
+        steps=jnp.int32(0),
+        sweeps=jnp.int32(0),
+        expansions=jnp.int32(0),
+        steals=jnp.int32(0),
+    )
+
+
+def init_frontier_packed(
+    roots: jax.Array, valid: jax.Array, config: SolverConfig
+) -> Frontier:
+    """Seed ONE job's subtree roots at the *configured* lane width.
+
+    Unlike :func:`init_frontier_roots` (one row per lane, so R roots force
+    >= R lanes), rows are dealt round-robin: row r lands on lane ``r % L`` —
+    the first as the lane's top, the rest pushed onto its stack.  A resumed
+    or offloaded search therefore runs at the same width (and the same
+    speculative-expansion budget) as the original, which keeps ``nodes``
+    counters comparable and the jit cache keyed on the row *bucket*, not the
+    exact row count.  ``valid`` masks terminal padding rows (invalid rows
+    must come last, so each lane's stack slots stay contiguous).
+    """
+    n_roots, h, w = roots.shape
+    s = config.stack_slots
+    import math
+
+    import numpy as np
+
+    if config.lanes > 0:
+        n_lanes = config.lanes
+    else:
+        n_lanes = max(config.min_lanes, math.ceil(n_roots / (1 + s)))
+    if n_roots > n_lanes * (1 + s):
+        raise ValueError(
+            f"{n_roots} roots exceed frontier capacity {n_lanes}x(1+{s})"
+        )
+    lane_of = jnp.asarray(np.arange(n_roots) % n_lanes, jnp.int32)
+    slot_of = jnp.asarray(np.arange(n_roots) // n_lanes, jnp.int32)
+    valid = jnp.asarray(valid, bool)
+
+    is_top = valid & (slot_of == 0)
+    lane_top = jnp.where(is_top, lane_of, n_lanes)  # OOB -> dropped
+    top = jnp.zeros((n_lanes, h, w), jnp.uint32).at[lane_top].set(
+        roots.astype(jnp.uint32), mode="drop"
+    )
+    has_top = jnp.zeros(n_lanes, bool).at[lane_top].set(True, mode="drop")
+    job = jnp.full(n_lanes, -1, jnp.int32).at[lane_top].set(0, mode="drop")
+
+    is_stack = valid & (slot_of >= 1)
+    lane_st = jnp.where(is_stack, lane_of, n_lanes)
+    slot_st = jnp.clip(slot_of - 1, 0, s - 1)
+    stack = jnp.zeros((n_lanes, s, h, w), jnp.uint32).at[lane_st, slot_st].set(
+        roots.astype(jnp.uint32), mode="drop"
+    )
+    count = jnp.zeros(n_lanes, jnp.int32).at[lane_st].add(
+        is_stack.astype(jnp.int32), mode="drop"
+    )
+    return Frontier(
+        top=top,
+        has_top=has_top,
+        stack=stack,
+        base=jnp.zeros(n_lanes, jnp.int32),
+        count=count,
+        job=job,
+        solved=jnp.zeros(1, bool),
+        solution=jnp.zeros((1, h, w), jnp.uint32),
+        overflowed=jnp.zeros(1, bool),
+        nodes=jnp.zeros(1, jnp.int32),
+        steps=jnp.int32(0),
+        sweeps=jnp.int32(0),
+        expansions=jnp.int32(0),
+        steals=jnp.int32(0),
+    )
+
+
+def purge_jobs(state: Frontier, dead: jax.Array) -> Frontier:
+    """Clear every lane owned by a job in ``dead`` (bool[J]) — the in-graph
+    mid-flight CANCEL.
+
+    The reference's kernel polls for cancellation once per recursion step
+    (``/root/reference/DHT_Node.py:481-488``); here the chunked device loop
+    applies this purge between bounded-step chunks, so a host ``cancel``
+    frees the cancelled job's lanes within one chunk.  ``overflowed`` is set
+    for purged jobs so finalize reports "unknown", never a false
+    proven-unsat.
+    """
+    n_jobs = state.solved.shape[0]
+    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
+    lane_dead = (state.job >= 0) & dead[job_safe]
+    return state._replace(
+        has_top=state.has_top & ~lane_dead,
+        count=jnp.where(lane_dead, 0, state.count),
+        overflowed=state.overflowed | (dead & ~state.solved),
+    )
+
+
+def shed_rows(state: Frontier, job_id: jax.Array, k: int):
+    """Extract up to ``k`` bottom stack rows of ``job_id`` for off-device work.
+
+    The donor side of *cluster-tier* mid-job offload: bottom rows are the
+    shallowest deferred siblings — the largest unexplored subtrees — exactly
+    what the reference ships when it halves its live guess range for an idle
+    neighbor (``/root/reference/DHT_Node.py:499-510``).  One row per donor
+    lane per call (a pointer bump, like :func:`_steal`).  Returns
+    ``(new_state, rows uint32[k, h, w], valid bool[k])``.
+    """
+    n_lanes, s = state.stack.shape[:2]
+    n_jobs = state.solved.shape[0]
+    job_live = (state.job == job_id) & ~state.solved[jnp.clip(state.job, 0, n_jobs - 1)]
+    donor = job_live & (state.count >= 1)
+    donor_of = _lane_by_rank(donor, n_lanes)
+    donor_lane = donor_of[jnp.arange(k, dtype=jnp.int32)]  # n_lanes if absent
+    valid = donor_lane < n_lanes
+    safe = jnp.clip(donor_lane, 0, n_lanes - 1)
+    rows = state.stack[safe, state.base[safe] % s]
+    rows = jnp.where(valid[:, None, None], rows, 0)
+    donor_sel = jnp.zeros(n_lanes, bool).at[donor_lane].set(valid, mode="drop")
+    new_state = state._replace(
+        base=jnp.where(donor_sel, (state.base + 1) % s, state.base),
+        count=jnp.where(donor_sel, state.count - 1, state.count),
+    )
+    return new_state, rows, valid
+
+
 def _rank_of(mask: jax.Array) -> jax.Array:
     """int32[L]: 0-based rank of each True lane among the True lanes."""
     return jnp.cumsum(mask.astype(jnp.int32)) - 1

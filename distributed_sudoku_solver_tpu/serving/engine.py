@@ -1,4 +1,4 @@
-"""Host-side job engine: an async queue feeding batched device solves.
+"""Host-side job engine: an async queue feeding chunked, batched device solves.
 
 Replaces the reference's per-node `task_queue` + busy-poll `/solve` plumbing
 (``/root/reference/DHT_Node.py:35,225-250,553-554``) with a single-owner
@@ -7,31 +7,47 @@ there is none of the reference's unlocked cross-thread mutation):
 
 * **submit** enqueues a uuid-tagged job and returns immediately; callers wait
   on the job's event (no 10 ms busy-poll — a real `threading.Event`).
-* **the device loop** drains the queue, groups jobs by geometry, pads each
-  group to a bucketed batch size (bounding jit cache growth), and runs the
-  compiled frontier solve; results resolve each job's event.
-* **cancel** is the SOLUTION_FOUND purge at host level: a cancelled uuid is
-  dropped from the queue, or its result discarded if already in flight
-  (in-graph cancellation between concurrent jobs lives in the frontier
-  itself, ``ops/frontier.py``).
+* **the device loop** drains the queue into *flights*: a flight is one
+  geometry-grouped batch of jobs sharing one frontier.  Each flight advances
+  in bounded-step chunks (``advance_frontier``), and multiple flights
+  round-robin — a hard batch no longer head-of-line-blocks later jobs, the
+  way the reference's single-threaded solve loop blocked its whole node
+  until the next message poll.
+* **cancel** lands *mid-flight*: between chunks the loop purges cancelled
+  jobs' lanes in-graph (``ops/frontier.purge_jobs``), freeing the device
+  within one chunk — the chunked heir of the reference's once-per-recursion
+  cancellation poll (``/root/reference/DHT_Node.py:481-488``).  In-graph
+  cancellation *between* concurrent jobs of one flight is the frontier's own
+  solved-mask purge (``ops/frontier.py``).
+* **snapshot / shed**: between chunks the loop also services control
+  requests — extracting a job's surviving subtree roots (its tops + stack
+  rows) for progress checkpoints, or *removing* bottom stack rows to ship to
+  an idle cluster peer (``ops/frontier.shed_rows``) — the live-range split
+  of ``/root/reference/DHT_Node.py:491-510`` at host level.
 * **stats** mirrors the reference's counters: ``validations`` = branch nodes
   expanded (``/root/reference/DHT_Node.py:512-513`` analog), ``solved_count``
   (``:37,428``).
+
+An explicit ``solve_fn`` override (tests' oracle backends, the sharded
+multi-chip path) keeps the legacy one-dispatch-per-batch behavior; the
+default path is the chunked flight loop.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import queue
 import threading
 import time
 import uuid as uuid_mod
-from typing import Optional
+from typing import Any, Optional
 
+import jax
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
-from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
 from distributed_sudoku_solver_tpu.ops.solve import solve_batch
 
 
@@ -42,12 +58,21 @@ class Job:
     uuid: str
     grid: np.ndarray
     geom: Geometry
+    # A resumed/offloaded job re-enters as subtree roots (uint32 candidate
+    # rows [R, h, w]) instead of a clue grid; `grid` is then unused.
+    roots: Optional[np.ndarray] = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     solution: Optional[np.ndarray] = None
     solved: bool = False
     unsat: bool = False
     nodes: int = 0
     cancelled: bool = False
+    # Mid-job offload bookkeeping: rows shed to a peer leave the local search
+    # space incomplete, so "local space exhausted" (`exhausted`) is no longer
+    # a proof of unsatisfiability (`unsat`) — the cluster layer aggregates
+    # exhaustion across all shipped parts before claiming unsat.
+    shed_parts: int = 0
+    exhausted: bool = False
     error: Optional[str] = None
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
 
@@ -63,6 +88,38 @@ def _bucket(n: int, max_batch: int) -> int:
     return b
 
 
+@dataclasses.dataclass
+class _Flight:
+    """One in-progress device batch: jobs sharing a frontier, advanced in chunks."""
+
+    geom: Geometry
+    jobs: list  # list[Job]; index in this list == in-graph job id
+    state: Frontier
+    started: float = dataclasses.field(default_factory=time.monotonic)
+    chunks: int = 0
+
+
+@dataclasses.dataclass
+class _Control:
+    """A cross-thread request the device loop services between chunks.
+
+    The abandon handshake closes a work-loss hole: if the waiter times out
+    before the loop services a *shed* (a long compile or handicapped chunk),
+    the rows must NOT be removed — nobody would ship them, and the job's
+    later exhaustion would read as a false unsat proof.  Waiter and servicer
+    both take ``lock``; whoever wins decides (abandoned -> no-op, serviced
+    -> waiter returns the result even after its timeout raced).
+    """
+
+    kind: str  # 'snapshot' | 'shed'
+    uuid: Optional[str] = None
+    k: int = 8
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    abandoned: bool = False
+    result: Any = None
+
+
 class SolverEngine:
     """Single-owner device loop consuming a thread-safe job queue."""
 
@@ -72,18 +129,30 @@ class SolverEngine:
         max_batch: int = 256,
         batch_window_s: float = 0.002,
         solve_fn=None,
+        chunk_steps: int = 64,
+        max_flights: int = 4,
+        handicap_s: float = 0.0,
     ):
         self.config = config
         self.max_batch = max_batch
         self.batch_window_s = batch_window_s
+        self.chunk_steps = max(1, chunk_steps)
+        self.max_flights = max(1, max_flights)
+        # Slow-node simulator (the reference's per-guess sleep, `-d`,
+        # ``DHT_Node.py:38,524``): flights sleep per *chunk*, the legacy
+        # path per batch.
+        self.handicap_s = handicap_s
         self._solve_fn = solve_fn or (
             lambda grids, geom, cfg: solve_batch(grids, geom, cfg)
         )
+        self._use_flights = solve_fn is None
         from distributed_sudoku_solver_tpu.utils.profiling import StatWindow
 
         self.latency = StatWindow()  # seconds per job
         self.batch_sizes = StatWindow()  # jobs per device batch
         self._queue: "queue.Queue[Job]" = queue.Queue()
+        self._control: "queue.Queue[_Control]" = queue.Queue()
+        self._flights: list[_Flight] = []  # owned by the device loop
         # Insertion-ordered so stale entries (cancels for jobs that already
         # finished or never arrive) can be pruned oldest-first.
         self._cancelled: "dict[str, None]" = {}
@@ -116,11 +185,70 @@ class SolverEngine:
         self._queue.put(job)
         return job
 
+    def submit_roots(
+        self, roots, geom: Geometry, job_uuid: Optional[str] = None
+    ) -> Job:
+        """Submit a job whose search space is given subtree roots (candidate
+        rows uint32[R, h, w]) rather than a clue grid — the entry point for
+        checkpoint resume and cluster mid-job offload."""
+        r = np.ascontiguousarray(np.asarray(roots, dtype=np.uint32))
+        if r.ndim != 3 or r.shape[1:] != (geom.n, geom.n):
+            raise ValueError(f"roots shape {r.shape} does not match geometry {geom}")
+        if r.shape[0] == 0:
+            raise ValueError("roots must contain at least one row")
+        job = Job(
+            uuid=job_uuid or str(uuid_mod.uuid4()),
+            grid=np.zeros((geom.n, geom.n), np.int32),
+            geom=geom,
+            roots=r,
+        )
+        self._queue.put(job)
+        return job
+
     def cancel(self, job_uuid: str) -> None:
         with self._lock:
             self._cancelled[job_uuid] = None
             while len(self._cancelled) > 4096:  # stale-cancel bound
                 self._cancelled.pop(next(iter(self._cancelled)))
+
+    def _request(self, req: _Control, timeout: float):
+        self._control.put(req)
+        if not req.done.wait(timeout):
+            with req.lock:
+                if not req.done.is_set():
+                    req.abandoned = True  # servicer will no-op
+                    return None
+            # Serviced between the wait timing out and us taking the lock.
+        return req.result
+
+    def snapshot_rows(self, job_uuid: str, timeout: float = 10.0):
+        """Current surviving subtree roots of an in-flight job.
+
+        Returns ``(rows uint32[R, h, w], nodes int, shed_parts int)`` or
+        None (job unknown / already resolved / engine stopped).  Serviced by
+        the device loop between chunks, so the result is a consistent
+        frontier cut — and because sheds are serviced by the same thread,
+        ``shed_parts == 0`` proves no rows had left this job before the cut,
+        i.e. the rows are a *complete* cover of its remaining space.
+        """
+        return self._request(_Control(kind="snapshot", uuid=job_uuid), timeout)
+
+    def shed_work(self, k: int = 8, timeout: float = 10.0):
+        """Remove up to ``k`` bottom stack rows from the neediest in-flight
+        job and return ``(job_uuid, rows uint32[<=k, h, w])``, or None.
+
+        The donor half of cluster mid-job offload: the caller ships the rows
+        to an idle peer, which re-enters them via :meth:`submit_roots`.
+        """
+        return self._request(_Control(kind="shed", k=max(1, k)), timeout)
+
+    def busy_depth(self) -> int:
+        """Queued jobs + unresolved jobs across active flights (approximate —
+        flights list is read without the device loop's coordination)."""
+        n = self._queue.qsize()
+        for fl in list(self._flights):
+            n += sum(0 if j.done.is_set() else 1 for j in fl.jobs)
+        return n
 
     def stats(self) -> dict:
         return {
@@ -145,12 +273,13 @@ class SolverEngine:
                 "count": bs["count"],
                 **{k: round(bs[k], 1) for k in ("p50", "p95")},
             }
+        out["active_flights"] = len(self._flights)
         return out
 
     # -- device loop ---------------------------------------------------------
-    def _take_batch(self) -> list[Job]:
+    def _take_batch(self, wait: bool) -> list[Job]:
         try:
-            first = self._queue.get(timeout=0.05)
+            first = self._queue.get(timeout=0.05 if wait else 0)
         except queue.Empty:
             return []
         jobs = [first]
@@ -169,11 +298,24 @@ class SolverEngine:
         with self._lock:
             return self._cancelled.pop(job.uuid, "absent") is None
 
+    def _peek_cancels(self, jobs: list[Job]) -> list[int]:
+        with self._lock:
+            return [
+                i
+                for i, j in enumerate(jobs)
+                if not j.done.is_set() and j.uuid in self._cancelled
+            ]
+
     def _run(self) -> None:
         while not self._stop.is_set():
-            jobs = self._take_batch()
-            if not jobs:
-                continue
+            # Admit new work (non-blocking while flights are active so a
+            # running chunk never starves the queue check); the flight cap
+            # bounds concurrent device frontiers — excess jobs wait queued.
+            jobs = (
+                self._take_batch(wait=not self._flights)
+                if len(self._flights) < self.max_flights
+                else []
+            )
             live: list[Job] = []
             for job in jobs:
                 if self._consume_cancel(job):
@@ -181,7 +323,6 @@ class SolverEngine:
                     job.done.set()
                 else:
                     live.append(job)
-            # Group by geometry: one compiled program per (bucket, geometry).
             by_geom: dict[Geometry, list[Job]] = {}
             for job in live:
                 by_geom.setdefault(job.geom, []).append(job)
@@ -190,20 +331,239 @@ class SolverEngine:
                 # (compile error, bad config, OOM): fail the batch's jobs,
                 # keep serving — a dead loop would strand every later job.
                 try:
-                    self._solve_group(geom, group)
+                    if self._use_flights:
+                        self._launch_flights(geom, group)
+                    else:
+                        self._solve_group(geom, group)
                 except Exception as e:  # noqa: BLE001
                     for job in group:
                         if not job.done.is_set():
                             job.error = f"{type(e).__name__}: {e}"
                             job.done.set()
                     print(f"[engine] batch failed ({geom}): {e!r}")
+            self._service_controls()
+            # Round-robin: advance every active flight by one chunk.
+            for fl in list(self._flights):
+                try:
+                    finished = self._advance_flight(fl)
+                except Exception as e:  # noqa: BLE001
+                    for job in fl.jobs:
+                        if not job.done.is_set():
+                            job.error = f"{type(e).__name__}: {e}"
+                            job.done.set()
+                    self._flights.remove(fl)
+                    print(f"[engine] flight failed ({fl.geom}): {e!r}")
+                    continue
+                if finished:
+                    self._flights.remove(fl)
 
+    # -- flight path (default) ----------------------------------------------
+    def _launch_flights(self, geom: Geometry, group: list[Job]) -> None:
+        # Roots jobs (resume / offloaded subtrees) fly solo with *packed*
+        # seeding: their rows deal round-robin onto the configured lane
+        # width, so a resume runs at the same width — and the same
+        # speculative-expansion budget — as the original search.
+        for job in group:
+            if job.roots is not None:
+                self._start_packed_flight(geom, job)
+        group = [j for j in group if j.roots is None]
+        cap = self.config.lanes if self.config.lanes > 0 else self.max_batch
+        for i in range(0, len(group), cap):
+            self._start_flight(geom, group[i : i + cap])
+
+    def _start_packed_flight(self, geom: Geometry, job: Job) -> None:
+        import jax.numpy as jnp
+
+        r = job.roots
+        bucket = _bucket(len(r), 1 << 30)
+        if self.config.lanes > 0:
+            # Cap padding at frontier capacity: the capacity check counts the
+            # padded bucket, and a resume of R valid rows must not fail just
+            # because the next power of two overshoots (R itself still fits).
+            capacity = self.config.lanes * (1 + self.config.stack_slots)
+            bucket = min(bucket, max(capacity, len(r)))
+        roots = np.zeros((bucket, geom.n, geom.n), np.uint32)
+        roots[: len(r)] = r
+        valid = np.arange(bucket) < len(r)
+        state = _start_packed(jnp.asarray(roots), jnp.asarray(valid), self.config)
+        self._flights.append(_Flight(geom=geom, jobs=[job], state=state))
+
+    def _start_flight(self, geom: Geometry, jobs: list[Job]) -> None:
+        """Grid jobs only (roots jobs fly packed): one root per job."""
+        import jax.numpy as jnp
+
+        from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+
+        n = geom.n
+        bucket = _bucket(len(jobs), max(self.max_batch, len(jobs)))
+        if self.config.lanes > 0:
+            # A fixed (possibly non-power-of-two) lane count is a hard cap:
+            # resolve_lanes rejects more roots than lanes.
+            bucket = min(bucket, self.config.lanes)
+        roots = np.zeros((bucket, n, n), np.uint32)
+        job_of_root = np.full(bucket, -1, np.int32)
+        grids = np.stack([job.grid for job in jobs])
+        roots[: len(jobs)] = np.asarray(encode_grid(jnp.asarray(grids), geom), np.uint32)
+        job_of_root[: len(jobs)] = np.arange(len(jobs), dtype=np.int32)
+        state = _start_roots(
+            jnp.asarray(roots), jnp.asarray(job_of_root), bucket, self.config
+        )
+        self._flights.append(_Flight(geom=geom, jobs=jobs, state=state))
+
+    def _advance_flight(self, fl: _Flight) -> bool:
+        """One bounded-step chunk; returns True when the flight is done."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_sudoku_solver_tpu.ops.frontier import frontier_live
+        from distributed_sudoku_solver_tpu.utils.checkpoint import advance_frontier
+
+        if self.handicap_s:
+            time.sleep(self.handicap_s)
+        # Mid-flight cancellation: purge cancelled jobs' lanes in-graph.
+        cancel_idx = self._peek_cancels(fl.jobs)
+        if cancel_idx:
+            dead = np.zeros(len(fl.state.solved), bool)
+            dead[cancel_idx] = True
+            fl.state = _purge(fl.state, jnp.asarray(dead))
+            for i in cancel_idx:
+                job = fl.jobs[i]
+                if self._consume_cancel(job):
+                    job.cancelled = True
+                self._finish_job(job)
+        limit = jnp.int32(
+            min(int(fl.state.steps) + self.chunk_steps, self.config.max_steps)
+        )
+        fl.state = advance_frontier(fl.state, limit, fl.geom, self.config)
+        jax.block_until_ready(fl.state)
+        fl.chunks += 1
+        solved = np.asarray(fl.state.solved)
+        any_live = bool(np.asarray(frontier_live(fl.state)).any())
+        out_of_budget = int(fl.state.steps) >= self.config.max_steps
+        # Early per-job resolution: a solved job's waiter unblocks now, not
+        # when the whole flight drains.
+        if any_live and not out_of_budget:
+            for i, job in enumerate(fl.jobs):
+                if solved[i] and not job.done.is_set():
+                    self._resolve_from_state(fl, i, job)
+            return False
+        res = _finalize_jit(fl.state)
+        solutions = np.asarray(res.solution)
+        unsat = np.asarray(res.unsat)
+        nodes = np.asarray(res.nodes)
+        solved = np.asarray(res.solved)
+        for i, job in enumerate(fl.jobs):
+            if job.done.is_set():
+                continue
+            job.solved = bool(solved[i])
+            job.exhausted = bool(unsat[i])
+            job.unsat = job.exhausted and job.shed_parts == 0
+            job.nodes = int(nodes[i])
+            if job.solved:
+                job.solution = solutions[i]
+            if self._consume_cancel(job):
+                job.cancelled = True
+            self._finish_job(job)
+        self.batch_sizes.record(float(len(fl.jobs)))
+        return True
+
+    def _resolve_from_state(self, fl: _Flight, i: int, job: Job) -> None:
+        from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid
+
+        job.solved = True
+        job.solution = np.asarray(decode_grid(fl.state.solution[i]), np.int32)
+        job.nodes = int(np.asarray(fl.state.nodes[i]))
+        self._finish_job(job)
+
+    def _finish_job(self, job: Job) -> None:
+        self.latency.record(time.monotonic() - job.submitted_at)
+        if job.solved:
+            self.solved_count += 1
+        self.validations += job.nodes
+        self.jobs_done += 1
+        job.done.set()
+
+    # -- control requests (snapshot / shed) ----------------------------------
+    def _service_controls(self) -> None:
+        while True:
+            try:
+                req = self._control.get_nowait()
+            except queue.Empty:
+                return
+            with req.lock:
+                if req.abandoned:
+                    req.done.set()
+                    continue  # waiter gave up; must not mutate state for it
+                try:
+                    if req.kind == "snapshot":
+                        req.result = self._do_snapshot(req.uuid)
+                    elif req.kind == "shed":
+                        req.result = self._do_shed(req.k)
+                except Exception as e:  # noqa: BLE001
+                    req.result = None
+                    print(f"[engine] control {req.kind} failed: {e!r}")
+                finally:
+                    req.done.set()
+
+    def _find_flight(self, job_uuid: str):
+        for fl in self._flights:
+            for i, job in enumerate(fl.jobs):
+                if job.uuid == job_uuid:
+                    return fl, i
+        return None, -1
+
+    def _do_snapshot(self, job_uuid: str):
+        fl, i = self._find_flight(job_uuid)
+        if fl is None or fl.jobs[i].done.is_set():
+            return None
+        rows = _rows_of_job_host(fl.state, i)
+        if rows.shape[0] == 0:
+            return None
+        return rows, int(np.asarray(fl.state.nodes[i])), fl.jobs[i].shed_parts
+
+    def _do_shed(self, k: int):
+        import jax.numpy as jnp
+
+        # Neediest job: most deferred stack rows across lanes (host-side scan
+        # of the small [L] vectors); shedding is rare, one sync is fine.
+        best = None  # (stack_rows, flight, job index)
+        for fl in self._flights:
+            jobv = np.asarray(fl.state.job)
+            countv = np.asarray(fl.state.count)
+            solvedv = np.asarray(fl.state.solved)
+            for i, job in enumerate(fl.jobs):
+                if job.done.is_set() or solvedv[i]:
+                    continue
+                depth = int(countv[jobv == i].sum())
+                if depth >= 1 and (best is None or depth > best[0]):
+                    best = (depth, fl, i)
+        if best is None:
+            return None
+        _, fl, i = best
+        new_state, rows, valid = _shed_jit(fl.state, jnp.int32(i), k)
+        fl.state = new_state
+        rows = np.asarray(rows)[np.asarray(valid)]
+        if rows.shape[0] == 0:
+            return None
+        fl.jobs[i].shed_parts += 1
+        return fl.jobs[i].uuid, rows
+
+    # -- legacy one-dispatch path (solve_fn overrides) ------------------------
     def _solve_group(self, geom: Geometry, group: list[Job]) -> None:
         # Respect an explicit lane cap: a fixed-lanes config can only take
         # batches up to that many jobs per compiled call.
         if self.config.lanes > 0 and len(group) > self.config.lanes:
             for i in range(0, len(group), self.config.lanes):
                 self._solve_group(geom, group[i : i + self.config.lanes])
+            return
+        if self.handicap_s:
+            time.sleep(self.handicap_s)
+        for job in group:
+            if job.roots is not None:
+                job.error = "roots jobs require the flight path (no solve_fn override)"
+                job.done.set()
+        group = [j for j in group if not j.done.is_set()]
+        if not group:
             return
         n = geom.n
         bucket = _bucket(len(group), self.max_batch)
@@ -241,3 +601,64 @@ class SolverEngine:
         self.validations += int(nodes[: len(group)].sum())
         self.solved_count += int(solved[: len(group)].sum())
         self.jobs_done += len(group)
+
+
+# -- jitted helpers (module-level so the cache is shared across engines) ------
+@functools.partial(jax.jit, static_argnames=("n_jobs", "config"))
+def _start_roots(roots, job_of_root, n_jobs: int, config: SolverConfig) -> Frontier:
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier_roots
+
+    return init_frontier_roots(roots, job_of_root, n_jobs, config)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def _start_packed(roots, valid, config: SolverConfig) -> Frontier:
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier_packed
+
+    return init_frontier_packed(roots, valid, config)
+
+
+@jax.jit
+def _purge(state: Frontier, dead) -> Frontier:
+    from distributed_sudoku_solver_tpu.ops.frontier import purge_jobs
+
+    return purge_jobs(state, dead)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _shed_jit(state: Frontier, job_id, k: int):
+    from distributed_sudoku_solver_tpu.ops.frontier import shed_rows
+
+    return shed_rows(state, job_id, k)
+
+
+@jax.jit
+def _finalize_jit(state: Frontier):
+    from distributed_sudoku_solver_tpu.ops.solve import _finalize
+
+    return _finalize(state)
+
+
+def _rows_of_job_host(state: Frontier, job_index: int) -> np.ndarray:
+    """All surviving subtree roots of one job: its lanes' tops + stack rows.
+
+    Host-side numpy gather (engine-scale frontiers are a few MB); the result
+    re-seeds an equivalent search via ``init_frontier_roots`` — this is both
+    the progress-checkpoint payload and the offload wire format.
+    """
+    top = np.asarray(state.top)
+    has_top = np.asarray(state.has_top)
+    stack = np.asarray(state.stack)
+    base = np.asarray(state.base)
+    count = np.asarray(state.count)
+    job = np.asarray(state.job)
+    s = stack.shape[1]
+    rows = []
+    for lane in np.nonzero(job == job_index)[0]:
+        if has_top[lane]:
+            rows.append(top[lane])
+        for i in range(int(count[lane])):
+            rows.append(stack[lane, (int(base[lane]) + i) % s])
+    if not rows:
+        return np.zeros((0,) + top.shape[1:], np.uint32)
+    return np.stack(rows).astype(np.uint32)
