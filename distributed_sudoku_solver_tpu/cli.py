@@ -52,9 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument(
         "--branch",
-        choices=("minrem", "first", "mixed"),
+        choices=("minrem", "first", "mixed", "minrem-desc"),
         default="minrem",
-        help="branch heuristic (first = reference-order bit-exact DFS)",
+        help="branch heuristic (first = reference-order bit-exact DFS; "
+        "minrem-desc = MRV with descending digit order, the portfolio mirror)",
     )
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")

@@ -86,6 +86,17 @@ def unpack_rows(d: dict) -> np.ndarray:
     return rows.astype(np.uint32)
 
 
+def _config_from_dict(d: Optional[dict]):
+    """SolverConfig off the wire (None-tolerant): a shed part or resumed
+    snapshot searches under the same config the job was submitted with —
+    a portfolio racer's heterogeneity must survive the hop."""
+    if not d:
+        return None
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+
+    return SolverConfig(**d)
+
+
 @dataclasses.dataclass(frozen=True)
 class ClusterConfig:
     heartbeat_s: float = 1.0
@@ -537,13 +548,16 @@ class ClusterNode:
         geom=None,
         job_uuid: Optional[str] = None,
         base_nodes: int = 0,
+        config=None,
     ) -> _Exec:
         """Run a job (or subtree part) on the local engine under an _Exec
         aggregate; ``on_final`` fires exactly once with the merged result."""
         if roots is not None:
-            ej = self.engine.submit_roots(roots, geom, job_uuid=job_uuid)
+            ej = self.engine.submit_roots(
+                roots, geom, job_uuid=job_uuid, config=config
+            )
         else:
-            ej = self.engine.submit(grid, job_uuid=job_uuid)
+            ej = self.engine.submit(grid, job_uuid=job_uuid, config=config)
 
         def wrapped(result: dict) -> None:
             with self._lock:
@@ -685,6 +699,7 @@ class ClusterNode:
                 geom=geom,
                 job_uuid=job_uuid,
                 base_nodes=int(entry.get("nodes_done", 0)),
+                config=_config_from_dict(entry.get("config")),
             )
         else:
             self._start_exec(fin, grid=entry["grid"], job_uuid=job_uuid)
@@ -732,7 +747,7 @@ class ClusterNode:
             snap = self.engine.snapshot_rows(ex.uuid, timeout=2.0)
             if snap is None:
                 continue
-            rows, nodes, shed_parts = snap
+            rows, nodes, shed_parts, job_cfg = snap
             # Coverage gate: sheds and snapshots are serviced by the same
             # device-loop thread, so shed_parts==0 *at the cut* proves these
             # rows cover the job's entire remaining space.  Once anything
@@ -751,6 +766,7 @@ class ClusterNode:
                         "uuid": ex.uuid,
                         "rows": pack_rows(rows),
                         "nodes": int(nodes) + ex.base_nodes,
+                        "config": job_cfg,
                     },
                     self.config.io_timeout_s,
                 )
@@ -763,6 +779,7 @@ class ClusterNode:
             if entry is not None:
                 entry["rows"] = msg["rows"]
                 entry["nodes_done"] = int(msg["nodes"])
+                entry["config"] = msg.get("config")
 
     # -- mid-job offload (NEEDWORK -> SUBTASK -> PART_RESULT) ----------------
     def _on_needwork(self, requester: str) -> None:
@@ -771,7 +788,7 @@ class ClusterNode:
         shed = self.engine.shed_work(k=self.config.shed_k, timeout=2.0)
         if shed is None:
             return  # nothing worth splitting (reference: no task, no range > 1)
-        root_uuid, rows = shed
+        root_uuid, rows, job_cfg = shed
         with self._lock:
             ex = self._execs.get(root_uuid)
         part_uuid = f"{root_uuid}#p{time.monotonic_ns()}"
@@ -782,6 +799,7 @@ class ClusterNode:
             "part": part_uuid,
             "root": root_uuid,
             "rows": pack_rows(rows),
+            "config": job_cfg,  # the part searches under the job's config
             "report_to": self.addr_s,
         }
         try:
@@ -828,7 +846,13 @@ class ClusterNode:
             except WireError:
                 pass  # shedder died; the origin's repair path re-covers this
 
-        self._start_exec(fin, roots=rows, geom=geom, job_uuid=part_uuid)
+        self._start_exec(
+            fin,
+            roots=rows,
+            geom=geom,
+            job_uuid=part_uuid,
+            config=_config_from_dict(msg.get("config")),
+        )
 
     def _on_part_result(self, msg: dict) -> None:
         with self._lock:

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
-from distributed_sudoku_solver_tpu.ops.bitmask import lowest_bit, popcount
+from distributed_sudoku_solver_tpu.ops.bitmask import highest_bit, lowest_bit, popcount
 from distributed_sudoku_solver_tpu.ops.propagate import board_status, propagate
 
 
@@ -26,11 +26,13 @@ class SudokuCSP:
     ``branch``: 'minrem' picks the cell with fewest remaining candidates
     (MRV, fastest); 'first' picks the first undecided cell row-major — the
     reference's ``find_next_empty`` order (``/root/reference/utils.py:14-25``),
-    used by the bit-exactness tests; 'mixed' hashes each state to one of the
-    two — heuristic *diversification* across subtrees (the expert-parallel
-    analog, SURVEY.md §2.2: heterogeneous strategies per subproblem), which
-    hedges against boards adversarial to any single rule.  All rules are
-    deterministic, so solves stay reproducible.
+    used by the bit-exactness tests; 'minrem-desc' is MRV with *descending*
+    digit order (the portfolio-racing mirror, ``serving/portfolio.py``);
+    'mixed' hashes each state to one of minrem/first — heuristic
+    *diversification* across subtrees (the expert-parallel analog, SURVEY.md
+    §2.2: heterogeneous strategies per subproblem), which hedges against
+    boards adversarial to any single rule.  All rules are deterministic, so
+    solves stay reproducible.
     """
 
     geom: Geometry
@@ -40,7 +42,7 @@ class SudokuCSP:
     rules: str = "basic"
 
     def __post_init__(self) -> None:
-        if self.branch_rule not in ("minrem", "first", "mixed"):
+        if self.branch_rule not in ("minrem", "first", "mixed", "minrem-desc"):
             raise ValueError(f"unknown branch rule {self.branch_rule!r}")
         if self.propagator not in ("xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
@@ -88,9 +90,13 @@ class SudokuCSP:
         candidates, so the two children partition the parent exactly.
         """
         onehot = self._branch_cell_onehot(states)
-        low = lowest_bit(states)
-        guess = jnp.where(onehot, low, states)
-        rest = jnp.where(onehot, states & ~low, states)
+        pick = (
+            highest_bit(states)
+            if self.branch_rule == "minrem-desc"
+            else lowest_bit(states)
+        )
+        guess = jnp.where(onehot, pick, states)
+        rest = jnp.where(onehot, states & ~pick, states)
         return guess, rest
 
     def _branch_cell_onehot(self, cand: jax.Array) -> jax.Array:
@@ -101,7 +107,7 @@ class SudokuCSP:
         cell_idx = jnp.arange(n * n, dtype=jnp.int32)
         minrem_key = jnp.where(pc > 1, pc * (n * n) + cell_idx, jnp.int32(2**30))
         first_key = jnp.where(pc > 1, cell_idx, jnp.int32(2**30))
-        if self.branch_rule == "minrem":
+        if self.branch_rule in ("minrem", "minrem-desc"):
             key = minrem_key
         elif self.branch_rule == "first":
             key = first_key

@@ -31,6 +31,21 @@ def lowest_bit(x: jax.Array) -> jax.Array:
     return x & (~x + jnp.uint32(1))
 
 
+def highest_bit(x: jax.Array) -> jax.Array:
+    """Isolate the highest set bit: the *descending-digit* branch choice.
+
+    The value-order mirror of :func:`lowest_bit` — the portfolio axis
+    (SURVEY.md §2.2 EP analog): a solution living in high digits is found
+    orders of magnitude faster descending than ascending, and vice versa,
+    so racing both hedges worst-case DFS order.  Bit smear then keep the
+    top edge; 0 stays 0.
+    """
+    x = jnp.asarray(x, jnp.uint32)
+    for s in (1, 2, 4, 8, 16):
+        x = x | (x >> jnp.uint32(s))
+    return x ^ (x >> jnp.uint32(1))
+
+
 def is_single(x: jax.Array) -> jax.Array:
     """True where the cell is decided (exactly one candidate)."""
     return popcount(x) == 1

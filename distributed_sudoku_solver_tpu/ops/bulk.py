@@ -27,8 +27,10 @@ device results live at once.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -64,6 +66,16 @@ class BulkConfig:
     # gang of thief lanes; deep stacks make overflow impossible in practice.
     rungs: tuple = ((2048, 4, 64), (64, 64, 256))
     inflight: int = 3  # dispatched-ahead chunks before draining the oldest
+    # Dispatch-time bounds.  A single while_loop dispatch that runs for
+    # minutes trips device/RPC watchdogs and kills the worker (observed on a
+    # sparse 25x25 corpus through the tunnel: ~100k-step searches in one
+    # dispatch = guaranteed "TPU worker crashed").  The first pass gets a
+    # hard step cap (unresolved boards escalate); rungs advance in
+    # ``dispatch_steps`` chunks so each dispatch's wall time stays bounded
+    # regardless of how deep a straggler search runs.
+    first_pass_steps: int = 4096
+    dispatch_steps: int = 512
+    rung_stack_mb: int = 768  # cap on a rung's stack tensor (lanes x slots)
 
     def __post_init__(self) -> None:
         if self.propagator not in (None, "xla", "pallas", "slices"):
@@ -89,6 +101,33 @@ def _auto_propagator() -> str:
     import jax
 
     return "slices" if jax.default_backend() == "tpu" else "xla"
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "scfg"))
+def _rung_start(grids_u8, geom: Geometry, scfg: SolverConfig):
+    # uint8 upload (4x fewer bytes over a tunneled link); widen in-graph
+    # before mask encoding so n > 8 digits don't overflow the shift.
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier
+
+    return init_frontier(encode_grid(grids_u8.astype(jnp.int32), geom), scfg)
+
+
+@functools.partial(jax.jit, static_argnames=("geom",))
+def _rung_finish(state, geom: Geometry):
+    from distributed_sudoku_solver_tpu.ops.solve import _finalize
+
+    res = _finalize(state)
+    return wire.pack_result_device(
+        res.solution, res.solved, res.unsat, res.nodes > 0, geom
+    )
+
+
+@jax.jit
+def _any_live(state):
+    from distributed_sudoku_solver_tpu.ops.frontier import frontier_live
+
+    return jnp.any(frontier_live(state))
 
 
 def solve_bulk(
@@ -151,7 +190,7 @@ def solve_bulk(
     first_cfg = SolverConfig(
         lanes=chunk,
         stack_slots=config.stack_slots,
-        max_steps=config.max_steps,
+        max_steps=min(config.first_pass_steps, config.max_steps),
         max_sweeps=config.max_sweeps,
         propagator=prop,
         rules=config.rules,
@@ -182,6 +221,34 @@ def solve_bulk(
     searched = int(branched.sum())
 
     # --- escalation rungs: re-run unresolved stragglers with gangs --------
+    # Rungs run *stepped*: bounded-step advances instead of one monolithic
+    # while_loop dispatch, because stragglers are exactly the boards whose
+    # searches can run for minutes — long enough to trip device/RPC
+    # watchdogs in a single dispatch (see BulkConfig.dispatch_steps).
+    def run_rung_stepped(batch: np.ndarray, scfg: SolverConfig):
+        if mesh is not None:
+            # The sharded driver has its own in-graph loop; multi-chip rungs
+            # keep the one-dispatch path (no tunnel in a real mesh deployment).
+            from distributed_sudoku_solver_tpu.parallel.sharded import (
+                solve_batch_sharded_wire,
+            )
+
+            packed = jnp.asarray(wire.pack_grids_host(batch, geom))
+            res = solve_batch_sharded_wire(packed, geom, scfg, mesh)
+            return wire.unpack_result_host(np.asarray(res), geom)
+        from distributed_sudoku_solver_tpu.utils.checkpoint import advance_frontier
+
+        state = _rung_start(jnp.asarray(batch.astype(np.uint8)), geom, scfg)
+        limit = 0
+        while limit < scfg.max_steps:
+            limit = min(limit + config.dispatch_steps, scfg.max_steps)
+            state = advance_frontier(state, jnp.int32(limit), geom, scfg)
+            if not bool(_any_live(state)):
+                break
+        return wire.unpack_result_host(
+            np.asarray(_rung_finish(state, geom)), geom
+        )
+
     remaining = np.flatnonzero(~solved & ~unsat)
     for max_jobs, lanes_per_job, slots in config.rungs:
         if len(remaining) == 0:
@@ -191,6 +258,22 @@ def solve_bulk(
         jobs_per_chunk = min(
             max_jobs, max(64, 1 << (len(remaining) - 1).bit_length())
         )
+        # Cap the rung's stack tensor: gang widths were tuned on 9x9, and
+        # scaling them naively to giant geometries produces multi-GB stacks
+        # (observed: 4096 lanes x 256 slots x 25^2 crashes the XLA:TPU
+        # compile helper outright).  Narrow the gang first, then the chunk.
+        budget = config.rung_stack_mb << 20
+        cell_bytes = n * n * 4
+        while (
+            jobs_per_chunk * lanes_per_job * slots * cell_bytes > budget
+            and lanes_per_job > 1
+        ):
+            lanes_per_job //= 2
+        while (
+            jobs_per_chunk * lanes_per_job * slots * cell_bytes > budget
+            and jobs_per_chunk > 64
+        ):
+            jobs_per_chunk //= 2
         lanes = jobs_per_chunk * lanes_per_job
         scfg = SolverConfig(
             lanes=-(-lanes // n_dev) * n_dev,  # round up: lanes >= jobs always
@@ -206,9 +289,8 @@ def solve_bulk(
         still: list[int] = []
         for lo in range(0, len(remaining), jobs_per_chunk):
             idx = remaining[lo : lo + jobs_per_chunk]
-            res = run_chunk(pad_to(grids[idx], jobs_per_chunk), scfg)
-            r_sol, r_solved, r_unsat, _ = wire.unpack_result_host(
-                np.asarray(res), geom
+            r_sol, r_solved, r_unsat, _ = run_rung_stepped(
+                pad_to(grids[idx], jobs_per_chunk), scfg
             )
             r_sol, r_solved, r_unsat = (
                 r_sol[: len(idx)], r_solved[: len(idx)], r_unsat[: len(idx)],

@@ -69,7 +69,8 @@ class SolverConfig:
     max_steps: int = 100_000  # branch rounds before giving up
     max_sweeps: int = 64  # propagation sweeps per fixpoint (Sudoku adapter)
     branch: str = "minrem"  # Sudoku branch rule: 'minrem' | 'first' (ref
-    #   order, bit-exactness tests) | 'mixed' (per-state hash-diversified)
+    #   order, bit-exactness tests) | 'mixed' (per-state hash-diversified) |
+    #   'minrem-desc' (MRV, descending digits — the portfolio-racing mirror)
     rules: str = "basic"  # propagation strength: 'basic' (elimination +
     #   hidden singles) | 'extended' (+ box-line reductions, all backends)
     propagator: str = "xla"  # 'xla' | 'pallas' (VMEM kernel; batch solves only

@@ -153,56 +153,66 @@ def box_line_sweep(cand: jax.Array, geom: Geometry) -> jax.Array:
     the complementary cells.  Everything is bitwise OR/AND on uint32 masks
     over static small axes — no per-digit loop.
     """
-    lead = cand.shape[:-2]
-    n = geom.n
-
-    def one_direction(x: jax.Array, nv: int, bh: int, nh: int, bw: int) -> jax.Array:
-        """Rows direction on x[..., n, n]; the columns call passes the
-        *transposed* box layout (nh, bw, nv, bh) — with rectangular boxes
-        the two layouts differ, and using the row layout there silently
-        misaligns box boundaries (eliminates true digits on 12x12)."""
-        v = x.reshape(*lead, nv, bh, nh, bw)
-        # seg[..., v, r, h]: digit bits present in the box-row segment
-        seg = or_reduce(v, -1)
-
-        # pointing: bits in exactly one box-row of box (v, h)
-        p_once, p_twice = once_twice_reduce(jnp.swapaxes(seg, -1, -2), -1)
-        # [..., v, h] -> [..., v, 1, h]: broadcast the confined-bit mask over r
-        point = seg & jnp.swapaxes((p_once & ~p_twice)[..., None], -1, -2)
-        # eliminate `point` bits from the same global row in *other* boxes:
-        # OR over boxes h' != h, unrolled over the small nh axis.  With one
-        # box per row (nh == 1) there is no "other" box — the rule is
-        # vacuous, like the Mosaic twin's guard (_box_line_dir).
-        point_other = jnp.zeros_like(seg)
-        for h in range(nh):
-            others = [point[..., h2] for h2 in range(nh) if h2 != h]
-            acc = jnp.zeros_like(seg[..., 0])
-            for o in others:
-                acc = acc | o
-            point_other = point_other.at[..., h].set(acc)
-
-        # claiming: bits in exactly one box of the row (v, r)
-        c_once, c_twice = once_twice_reduce(seg, -1)
-        claim = seg & (c_once & ~c_twice)[..., None]
-        # eliminate `claim` bits from other box-rows of the same box (vacuous
-        # when bh == 1: a box one row tall has no other box-row).
-        claim_other = jnp.zeros_like(seg)
-        for r in range(bh):
-            others = [claim[..., r2, :] for r2 in range(bh) if r2 != r]
-            acc = jnp.zeros_like(seg[..., 0, :])
-            for o in others:
-                acc = acc | o
-            claim_other = claim_other.at[..., r, :].set(acc)
-
-        kill = (point_other | claim_other)[..., None]  # broadcast over bw
-        return (v & ~jnp.broadcast_to(kill, v.shape)).reshape(*lead, n, n)
-
     # Decided cells must keep their singleton bit: these rules only ever
     # remove candidates from *other* cells of the line/box, but guard anyway
     # so a (contradictory) board can't lose its decided marker silently.
     single = is_single(cand)
     nv, nh, bh, bw = geom.n_vboxes, geom.n_hboxes, geom.box_h, geom.box_w
-    out = one_direction(cand, nv, bh, nh, bw)
-    out_t = one_direction(jnp.swapaxes(out, -1, -2), nh, bw, nv, bh)
+    out = box_line_one_direction(cand, nv, bh, nh, bw)
+    out_t = box_line_one_direction(jnp.swapaxes(out, -1, -2), nh, bw, nv, bh)
     out = jnp.swapaxes(out_t, -1, -2)
     return jnp.where(single, cand, out)
+
+
+def box_line_one_direction(
+    x: jax.Array, nv: int, bh: int, nh: int, bw: int
+) -> jax.Array:
+    """Rows direction of the box-line rules on x[..., nv*bh, nh*bw].
+
+    The columns call passes the *transposed* box layout (nh, bw, nv, bh) —
+    with rectangular boxes the two layouts differ, and using the row layout
+    there silently misaligns box boundaries (eliminates true digits on
+    12x12).  Module-level so the board-sharded path
+    (``parallel/board_sharded.py``) can reuse it verbatim for its chip-local
+    rows direction: a row-band shard is just a stack of complete bands.
+    """
+    lead = x.shape[:-2]
+    v = x.reshape(*lead, nv, bh, nh, bw)
+    # seg[..., v, r, h]: digit bits present in the box-row segment
+    seg = or_reduce(v, -1)
+
+    # pointing: bits in exactly one box-row of box (v, h)
+    p_once, p_twice = once_twice_reduce(jnp.swapaxes(seg, -1, -2), -1)
+    # [..., v, h] -> [..., v, 1, h]: broadcast the confined-bit mask over r
+    point = seg & jnp.swapaxes((p_once & ~p_twice)[..., None], -1, -2)
+    # eliminate `point` bits from the same global row in *other* boxes:
+    # OR_{h' != h} x[h'] == (once & ~x[h]) | twice — a bit present in >= 2
+    # boxes is "other" everywhere, a bit present once is "other" exactly
+    # where it is absent.  Vacuous when nh == 1 (no other box), like the
+    # Mosaic twin's guard (_box_line_dir).
+    point_other = _or_others(point, -1)
+
+    # claiming: bits in exactly one box of the row (v, r)
+    c_once, c_twice = once_twice_reduce(seg, -1)
+    claim = seg & (c_once & ~c_twice)[..., None]
+    # eliminate `claim` bits from other box-rows of the same box (vacuous
+    # when bh == 1: a box one row tall has no other box-row).
+    claim_other = _or_others(claim, -2)
+
+    kill = (point_other | claim_other)[..., None]  # broadcast over bw
+    return (v & ~jnp.broadcast_to(kill, v.shape)).reshape(*lead, *x.shape[-2:])
+
+
+def _or_others(x: jax.Array, axis: int) -> jax.Array:
+    """Per slot along ``axis``: the OR of every *other* slot's bits.
+
+    The complement identity ``OR_{j != i} x[j] == (once & ~x[i]) | twice``
+    over the (once, twice) multiplicity aggregates — the same identity the
+    board-sharded columns direction uses across chips
+    (``parallel/board_sharded.py::_box_line_cols``), so "eliminate from the
+    other units" is one computation everywhere.
+    """
+    once, twice = once_twice_reduce(x, axis)
+    once = jnp.expand_dims(once, axis)
+    twice = jnp.expand_dims(twice, axis)
+    return (once & ~x) | twice

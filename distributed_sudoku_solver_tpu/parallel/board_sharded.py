@@ -145,6 +145,7 @@ class BandedSudoku:
     bands_per_chip: int
     branch_rule: str = "minrem"
     max_sweeps: int = 64
+    rules: str = "basic"  # 'basic' | 'extended' (+ banded box-line reductions)
 
     @property
     def rows_local(self) -> int:
@@ -225,7 +226,72 @@ class BandedSudoku:
         c_once, c_twice = ring_once_twice(c_once, c_twice, self.axis, self.n_dev)
         c_unique = (c_once & ~c_twice)[..., None, :]
         forced = forced | (cand & c_unique)
-        return jnp.where(~single & (forced != 0), forced, cand)
+        cand = jnp.where(~single & (forced != 0), forced, cand)
+        if self.rules == "extended":
+            cand = self._box_line(cand)
+        return cand
+
+    def _box_line(self, cand: jax.Array) -> jax.Array:
+        """Banded pointing/claiming (``ops/propagate.box_line_sweep`` twin).
+
+        Rows direction is chip-local (a shard is a stack of complete bands:
+        rows, boxes, and row-box interactions never cross chips) and reuses
+        :func:`~distributed_sudoku_solver_tpu.ops.propagate.box_line_one_direction`
+        verbatim.  The columns direction's cross-band aggregates ride the
+        same ring collectives as the basic sweep; the "eliminate from the
+        *other* units" complement uses the once/twice identity
+        ``OR_{b' != b} x[b'] == (once & ~x[b]) | twice``, which turns the
+        unsharded code's explicit loop over other bands into one global
+        (once, twice) all-reduce.  Op order matches the unsharded sweep:
+        rows direction first, then columns on its output, then the
+        decided-cell guard — bit-exactness is asserted by
+        ``tests/test_board_sharded.py``.
+        """
+        from distributed_sudoku_solver_tpu.ops.propagate import (
+            box_line_one_direction,
+        )
+
+        g = self.geom
+        single = is_single(cand)
+        out = box_line_one_direction(
+            cand, self.bands_per_chip, g.box_h, g.n_hboxes, g.box_w
+        )
+        out = self._box_line_cols(out)
+        return jnp.where(single, cand, out)
+
+    def _box_line_cols(self, x: jax.Array) -> jax.Array:
+        """Columns direction: generic roles (nv,bh,nh,bw) -> (nh,bw,nv,bh),
+        with the nv (band) axis sharded over chips."""
+        g = self.geom
+        nh, bw, bh = g.n_hboxes, g.box_w, g.box_h
+        n_b = self.bands_per_chip
+        lead = x.shape[:-2]
+        # [L, rows_local, n] -> transpose -> [L, nh, bw, bands_local, bh]
+        v = jnp.swapaxes(x, -1, -2).reshape(*lead, nh, bw, n_b, bh)
+        seg = or_reduce(v, -1)  # [L, nh, bw, B]: column segment per band
+
+        # Pointing: bits of box (colband, band) confined to one box-column;
+        # eliminate from that global column in every *other* band.
+        p_once, p_twice = once_twice_reduce(jnp.swapaxes(seg, -1, -2), -1)
+        point = seg & jnp.swapaxes((p_once & ~p_twice)[..., None], -1, -2)
+        l_once, l_twice = once_twice_reduce(point, -1)  # local band partials
+        g_once, g_twice = ring_once_twice(l_once, l_twice, self.axis, self.n_dev)
+        point_other = (g_once[..., None] & ~point) | g_twice[..., None]
+
+        # Claiming: bits of a global column confined to one band (cross-chip
+        # once/twice); eliminate from the other columns of that band's box.
+        from distributed_sudoku_solver_tpu.ops.propagate import _or_others
+
+        s_once, s_twice = once_twice_reduce(seg, -1)
+        gs_once, gs_twice = ring_once_twice(s_once, s_twice, self.axis, self.n_dev)
+        claim = seg & (gs_once & ~gs_twice)[..., None]
+        claim_other = _or_others(claim, -2)
+
+        kill = (point_other | claim_other)[..., None]  # broadcast over bh
+        out = v & ~jnp.broadcast_to(kill, v.shape)
+        return jnp.swapaxes(
+            out.reshape(*lead, g.n, self.rows_local), -1, -2
+        )
 
     def propagate(self, states: jax.Array) -> tuple[jax.Array, jax.Array]:
         """Sweep to a fixpoint; the 'changed' flag is globally agreed (psum)
@@ -325,7 +391,8 @@ class BandedSudoku:
     def signature(self) -> str:
         return (
             f"banded-sudoku:{self.geom.box_h}x{self.geom.box_w}"
-            f":{self.n_dev}x{self.bands_per_chip}:{self.branch_rule}:{self.max_sweeps}"
+            f":{self.n_dev}x{self.bands_per_chip}:{self.branch_rule}"
+            f":{self.max_sweeps}:{self.rules}"
         )
 
 
@@ -337,11 +404,15 @@ class BandedSudoku:
 def _banded_problem(
     geom: Geometry, config: SolverConfig, n_dev: int, axis: str
 ) -> BandedSudoku:
-    if config.rules != "basic":
-        # The banded sweep implements basic inference only; fail loudly
-        # (same convention as the propagator check below).
+    if config.rules not in ("basic", "extended"):
+        raise ValueError(f"unknown rules {config.rules!r}")
+    if config.branch not in ("minrem", "first"):
+        # The banded pmin-key branch implements these two orders only; fail
+        # loudly rather than silently fall back ('mixed'/'minrem-desc' are
+        # batch-path features).
         raise ValueError(
-            f"board-sharded solve supports rules='basic' only, got {config.rules!r}"
+            f"board-sharded solve supports branch='minrem'|'first', "
+            f"got {config.branch!r}"
         )
     if config.propagator != "xla":
         # The banded sweep has its own ring-exchange collectives; the Pallas
@@ -359,6 +430,7 @@ def _banded_problem(
         bands_per_chip=bands_per_chip,
         branch_rule=config.branch,
         max_sweeps=config.max_sweeps,
+        rules=config.rules,
     )
 
 

@@ -4,3 +4,8 @@ from distributed_sudoku_solver_tpu.serving.engine import (  # noqa: F401
     Job,
     SolverEngine,
 )
+from distributed_sudoku_solver_tpu.serving.portfolio import (  # noqa: F401
+    DEFAULT_PORTFOLIO,
+    PortfolioResult,
+    race,
+)

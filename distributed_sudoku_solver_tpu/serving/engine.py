@@ -61,6 +61,10 @@ class Job:
     # A resumed/offloaded job re-enters as subtree roots (uint32 candidate
     # rows [R, h, w]) instead of a clue grid; `grid` is then unused.
     roots: Optional[np.ndarray] = None
+    # Per-job solver-config override (portfolio racing, serving/portfolio.py):
+    # jobs group into flights by (geometry, config), so R configs of the same
+    # board race as R concurrent flights.  None = the engine default.
+    config: Optional[SolverConfig] = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     solution: Optional[np.ndarray] = None
     solved: bool = False
@@ -93,6 +97,7 @@ class _Flight:
     """One in-progress device batch: jobs sharing a frontier, advanced in chunks."""
 
     geom: Geometry
+    config: SolverConfig
     jobs: list  # list[Job]; index in this list == in-graph job id
     state: Frontier
     started: float = dataclasses.field(default_factory=time.monotonic)
@@ -111,13 +116,16 @@ class _Control:
     -> waiter returns the result even after its timeout raced).
     """
 
-    kind: str  # 'snapshot' | 'shed'
+    kind: str  # 'snapshot' | 'shed' | 'exec'
     uuid: Optional[str] = None
     k: int = 8
+    fn: Any = None  # 'exec': zero-arg callable run on the device-owner thread
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
     abandoned: bool = False
+    claimed: bool = False  # servicer took it; abandon is no longer possible
     result: Any = None
+    error: Optional[str] = None  # servicer-side exception, for exec callers
 
 
 class SolverEngine:
@@ -176,17 +184,29 @@ class SolverEngine:
             self._thread.join(timeout)
 
     # -- client API ----------------------------------------------------------
-    def submit(self, grid, geom: Optional[Geometry] = None, job_uuid: Optional[str] = None) -> Job:
+    def submit(
+        self,
+        grid,
+        geom: Optional[Geometry] = None,
+        job_uuid: Optional[str] = None,
+        config: Optional[SolverConfig] = None,
+    ) -> Job:
         g = np.asarray(grid, dtype=np.int32)
         geom = geom or geometry_for_size(g.shape[0])
         if g.shape != (geom.n, geom.n):
             raise ValueError(f"grid shape {g.shape} does not match geometry {geom}")
-        job = Job(uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom)
+        job = Job(
+            uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom, config=config
+        )
         self._queue.put(job)
         return job
 
     def submit_roots(
-        self, roots, geom: Geometry, job_uuid: Optional[str] = None
+        self,
+        roots,
+        geom: Geometry,
+        job_uuid: Optional[str] = None,
+        config: Optional[SolverConfig] = None,
     ) -> Job:
         """Submit a job whose search space is given subtree roots (candidate
         rows uint32[R, h, w]) rather than a clue grid — the entry point for
@@ -201,6 +221,7 @@ class SolverEngine:
             grid=np.zeros((geom.n, geom.n), np.int32),
             geom=geom,
             roots=r,
+            config=config,
         )
         self._queue.put(job)
         return job
@@ -215,19 +236,32 @@ class SolverEngine:
         self._control.put(req)
         if not req.done.wait(timeout):
             with req.lock:
-                if not req.done.is_set():
+                if not req.done.is_set() and not req.claimed:
                     req.abandoned = True  # servicer will no-op
                     return None
-            # Serviced between the wait timing out and us taking the lock.
+            # Claimed (running) or finished between the wait timing out and
+            # us taking the lock.  A running exec/snapshot is simply given
+            # up on — its result is discardable; a running *shed* has
+            # already pulled rows out of a frontier, so wait it out (it is
+            # one short jitted call) rather than drop work on the floor.
+            if req.kind != "shed" and not req.done.is_set():
+                return None
+            req.done.wait()
+        if req.error is not None and req.kind == "exec":
+            # exec callers must distinguish "fn raised" from "timed out":
+            # a 504-style retry against a deterministic failure loops forever.
+            raise RuntimeError(req.error)
         return req.result
 
     def snapshot_rows(self, job_uuid: str, timeout: float = 10.0):
         """Current surviving subtree roots of an in-flight job.
 
-        Returns ``(rows uint32[R, h, w], nodes int, shed_parts int)`` or
-        None (job unknown / already resolved / engine stopped).  Serviced by
-        the device loop between chunks, so the result is a consistent
-        frontier cut — and because sheds are serviced by the same thread,
+        Returns ``(rows uint32[R, h, w], nodes int, shed_parts int,
+        config dict)`` or None (job unknown / already resolved / engine
+        stopped).  ``config`` is the job's effective SolverConfig as a dict,
+        so a resume reconstructs the exact same search.  Serviced by the
+        device loop between chunks, so the result is a consistent frontier
+        cut — and because sheds are serviced by the same thread,
         ``shed_parts == 0`` proves no rows had left this job before the cut,
         i.e. the rows are a *complete* cover of its remaining space.
         """
@@ -235,12 +269,27 @@ class SolverEngine:
 
     def shed_work(self, k: int = 8, timeout: float = 10.0):
         """Remove up to ``k`` bottom stack rows from the neediest in-flight
-        job and return ``(job_uuid, rows uint32[<=k, h, w])``, or None.
+        job; returns ``(job_uuid, rows uint32[<=k, h, w], config dict)`` or
+        None.
 
-        The donor half of cluster mid-job offload: the caller ships the rows
-        to an idle peer, which re-enters them via :meth:`submit_roots`.
+        The donor half of cluster mid-job offload: the caller ships rows +
+        config to an idle peer, which re-enters them via
+        :meth:`submit_roots` under the same solver config (a portfolio
+        racer's heterogeneity survives the hop).
         """
         return self._request(_Control(kind="shed", k=max(1, k)), timeout)
+
+    def run_exclusive(self, fn, timeout: float = 600.0):
+        """Run ``fn()`` on the device-owner thread, between flight chunks.
+
+        The single-owner escape hatch for non-engine device work (the HTTP
+        bulk endpoint's ``ops/bulk`` dispatches): no second thread ever
+        talks to the device, and in-flight interactive jobs resume at the
+        next chunk boundary.  Returns ``fn()``'s result; returns None if the
+        engine never got to it within ``timeout`` (the abandoned request is
+        skipped, never run late); raises RuntimeError if ``fn`` itself
+        raised on the device loop."""
+        return self._request(_Control(kind="exec", fn=fn), timeout)
 
     def busy_depth(self) -> int:
         """Queued jobs + unresolved jobs across active flights (approximate —
@@ -323,18 +372,18 @@ class SolverEngine:
                     job.done.set()
                 else:
                     live.append(job)
-            by_geom: dict[Geometry, list[Job]] = {}
+            by_key: dict[tuple, list[Job]] = {}
             for job in live:
-                by_geom.setdefault(job.geom, []).append(job)
-            for geom, group in by_geom.items():
+                by_key.setdefault((job.geom, job.config or self.config), []).append(job)
+            for (geom, cfg), group in by_key.items():
                 # The device loop must survive anything a batch throws
                 # (compile error, bad config, OOM): fail the batch's jobs,
                 # keep serving — a dead loop would strand every later job.
                 try:
                     if self._use_flights:
-                        self._launch_flights(geom, group)
+                        self._launch_flights(geom, cfg, group)
                     else:
-                        self._solve_group(geom, group)
+                        self._solve_group(geom, group, cfg)
                 except Exception as e:  # noqa: BLE001
                     for job in group:
                         if not job.done.is_set():
@@ -358,37 +407,39 @@ class SolverEngine:
                     self._flights.remove(fl)
 
     # -- flight path (default) ----------------------------------------------
-    def _launch_flights(self, geom: Geometry, group: list[Job]) -> None:
+    def _launch_flights(
+        self, geom: Geometry, cfg: SolverConfig, group: list[Job]
+    ) -> None:
         # Roots jobs (resume / offloaded subtrees) fly solo with *packed*
         # seeding: their rows deal round-robin onto the configured lane
         # width, so a resume runs at the same width — and the same
         # speculative-expansion budget — as the original search.
         for job in group:
             if job.roots is not None:
-                self._start_packed_flight(geom, job)
+                self._start_packed_flight(geom, cfg, job)
         group = [j for j in group if j.roots is None]
-        cap = self.config.lanes if self.config.lanes > 0 else self.max_batch
+        cap = cfg.lanes if cfg.lanes > 0 else self.max_batch
         for i in range(0, len(group), cap):
-            self._start_flight(geom, group[i : i + cap])
+            self._start_flight(geom, cfg, group[i : i + cap])
 
-    def _start_packed_flight(self, geom: Geometry, job: Job) -> None:
+    def _start_packed_flight(self, geom: Geometry, cfg: SolverConfig, job: Job) -> None:
         import jax.numpy as jnp
 
         r = job.roots
         bucket = _bucket(len(r), 1 << 30)
-        if self.config.lanes > 0:
+        if cfg.lanes > 0:
             # Cap padding at frontier capacity: the capacity check counts the
             # padded bucket, and a resume of R valid rows must not fail just
             # because the next power of two overshoots (R itself still fits).
-            capacity = self.config.lanes * (1 + self.config.stack_slots)
+            capacity = cfg.lanes * (1 + cfg.stack_slots)
             bucket = min(bucket, max(capacity, len(r)))
         roots = np.zeros((bucket, geom.n, geom.n), np.uint32)
         roots[: len(r)] = r
         valid = np.arange(bucket) < len(r)
-        state = _start_packed(jnp.asarray(roots), jnp.asarray(valid), self.config)
-        self._flights.append(_Flight(geom=geom, jobs=[job], state=state))
+        state = _start_packed(jnp.asarray(roots), jnp.asarray(valid), cfg)
+        self._flights.append(_Flight(geom=geom, config=cfg, jobs=[job], state=state))
 
-    def _start_flight(self, geom: Geometry, jobs: list[Job]) -> None:
+    def _start_flight(self, geom: Geometry, cfg: SolverConfig, jobs: list[Job]) -> None:
         """Grid jobs only (roots jobs fly packed): one root per job."""
         import jax.numpy as jnp
 
@@ -396,19 +447,19 @@ class SolverEngine:
 
         n = geom.n
         bucket = _bucket(len(jobs), max(self.max_batch, len(jobs)))
-        if self.config.lanes > 0:
+        if cfg.lanes > 0:
             # A fixed (possibly non-power-of-two) lane count is a hard cap:
             # resolve_lanes rejects more roots than lanes.
-            bucket = min(bucket, self.config.lanes)
+            bucket = min(bucket, cfg.lanes)
         roots = np.zeros((bucket, n, n), np.uint32)
         job_of_root = np.full(bucket, -1, np.int32)
         grids = np.stack([job.grid for job in jobs])
         roots[: len(jobs)] = np.asarray(encode_grid(jnp.asarray(grids), geom), np.uint32)
         job_of_root[: len(jobs)] = np.arange(len(jobs), dtype=np.int32)
         state = _start_roots(
-            jnp.asarray(roots), jnp.asarray(job_of_root), bucket, self.config
+            jnp.asarray(roots), jnp.asarray(job_of_root), bucket, cfg
         )
-        self._flights.append(_Flight(geom=geom, jobs=jobs, state=state))
+        self._flights.append(_Flight(geom=geom, config=cfg, jobs=jobs, state=state))
 
     def _advance_flight(self, fl: _Flight) -> bool:
         """One bounded-step chunk; returns True when the flight is done."""
@@ -432,14 +483,14 @@ class SolverEngine:
                     job.cancelled = True
                 self._finish_job(job)
         limit = jnp.int32(
-            min(int(fl.state.steps) + self.chunk_steps, self.config.max_steps)
+            min(int(fl.state.steps) + self.chunk_steps, fl.config.max_steps)
         )
-        fl.state = advance_frontier(fl.state, limit, fl.geom, self.config)
+        fl.state = advance_frontier(fl.state, limit, fl.geom, fl.config)
         jax.block_until_ready(fl.state)
         fl.chunks += 1
         solved = np.asarray(fl.state.solved)
         any_live = bool(np.asarray(frontier_live(fl.state)).any())
-        out_of_budget = int(fl.state.steps) >= self.config.max_steps
+        out_of_budget = int(fl.state.steps) >= fl.config.max_steps
         # Early per-job resolution: a solved job's waiter unblocks now, not
         # when the whole flight drains.
         if any_live and not out_of_budget:
@@ -494,16 +545,22 @@ class SolverEngine:
                 if req.abandoned:
                     req.done.set()
                     continue  # waiter gave up; must not mutate state for it
-                try:
-                    if req.kind == "snapshot":
-                        req.result = self._do_snapshot(req.uuid)
-                    elif req.kind == "shed":
-                        req.result = self._do_shed(req.k)
-                except Exception as e:  # noqa: BLE001
-                    req.result = None
-                    print(f"[engine] control {req.kind} failed: {e!r}")
-                finally:
-                    req.done.set()
+                req.claimed = True
+            # Run OUTSIDE the lock: a long exec (bulk chunk) must not block
+            # a timed-out waiter that is merely trying to record its abandon.
+            try:
+                if req.kind == "snapshot":
+                    req.result = self._do_snapshot(req.uuid)
+                elif req.kind == "shed":
+                    req.result = self._do_shed(req.k)
+                elif req.kind == "exec":
+                    req.result = req.fn()
+            except Exception as e:  # noqa: BLE001
+                req.result = None
+                req.error = f"{type(e).__name__}: {e}"
+                print(f"[engine] control {req.kind} failed: {e!r}")
+            finally:
+                req.done.set()
 
     def _find_flight(self, job_uuid: str):
         for fl in self._flights:
@@ -519,7 +576,12 @@ class SolverEngine:
         rows = _rows_of_job_host(fl.state, i)
         if rows.shape[0] == 0:
             return None
-        return rows, int(np.asarray(fl.state.nodes[i])), fl.jobs[i].shed_parts
+        return (
+            rows,
+            int(np.asarray(fl.state.nodes[i])),
+            fl.jobs[i].shed_parts,
+            dataclasses.asdict(fl.config),
+        )
 
     def _do_shed(self, k: int):
         import jax.numpy as jnp
@@ -546,15 +608,18 @@ class SolverEngine:
         if rows.shape[0] == 0:
             return None
         fl.jobs[i].shed_parts += 1
-        return fl.jobs[i].uuid, rows
+        return fl.jobs[i].uuid, rows, dataclasses.asdict(fl.config)
 
     # -- legacy one-dispatch path (solve_fn overrides) ------------------------
-    def _solve_group(self, geom: Geometry, group: list[Job]) -> None:
+    def _solve_group(
+        self, geom: Geometry, group: list[Job], cfg: Optional[SolverConfig] = None
+    ) -> None:
+        cfg = cfg or self.config
         # Respect an explicit lane cap: a fixed-lanes config can only take
         # batches up to that many jobs per compiled call.
-        if self.config.lanes > 0 and len(group) > self.config.lanes:
-            for i in range(0, len(group), self.config.lanes):
-                self._solve_group(geom, group[i : i + self.config.lanes])
+        if cfg.lanes > 0 and len(group) > cfg.lanes:
+            for i in range(0, len(group), cfg.lanes):
+                self._solve_group(geom, group[i : i + cfg.lanes], cfg)
             return
         if self.handicap_s:
             time.sleep(self.handicap_s)
@@ -567,8 +632,8 @@ class SolverEngine:
             return
         n = geom.n
         bucket = _bucket(len(group), self.max_batch)
-        if self.config.lanes > 0:
-            bucket = min(bucket, self.config.lanes)
+        if cfg.lanes > 0:
+            bucket = min(bucket, cfg.lanes)
         grids = np.zeros((bucket, n, n), dtype=np.int32)
         for i, job in enumerate(group):
             grids[i] = job.grid
@@ -580,7 +645,7 @@ class SolverEngine:
 
         grids[len(group) :] = solved_board(geom)
 
-        res = self._solve_fn(grids, geom, self.config)
+        res = self._solve_fn(grids, geom, cfg)
         solved = np.asarray(res.solved)
         unsat = np.asarray(res.unsat)
         solutions = np.asarray(res.solution)

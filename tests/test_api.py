@@ -85,6 +85,52 @@ def test_unknown_paths(server):
     assert _request(server, "/nope")[0] == 404
 
 
+def test_solve_batch_endpoint_boards(server):
+    """POST /solve_batch with nested grids (VERDICT r1 #6): bulk over HTTP,
+    routed through ops/bulk on the engine's device-owner thread."""
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    bad = np.asarray(EASY_9).copy()
+    bad[0, 0], bad[0, 1] = 5, 5
+    boards = [np.asarray(EASY_9), np.asarray(HARD_9[0]), bad]
+    status, body = _request(
+        server,
+        "/solve_batch",
+        {"boards": [b.tolist() for b in boards], "chunk": 2},
+    )
+    assert status == 200
+    assert body["count"] == 3
+    assert body["solved"] == 2
+    assert body["unsat"] == 1
+    assert body["solved_mask"] == [True, True, False]
+    assert body["unsat_mask"] == [False, False, True]
+    for i in (0, 1):
+        sol = np.asarray(body["solutions"][i])
+        assert is_valid_solution(sol)
+        mask = boards[i] != 0
+        assert np.array_equal(sol[mask], boards[i][mask])
+    assert body["duration"] > 0
+
+
+def test_solve_batch_endpoint_lines(server):
+    from distributed_sudoku_solver_tpu.utils.puzzles import to_line
+
+    status, body = _request(
+        server,
+        "/solve_batch",
+        {"lines": [to_line(np.asarray(EASY_9))], "size": 9},
+    )
+    assert status == 200
+    assert body["solved"] == 1
+    sol_line = body["solutions"][0]
+    assert len(sol_line) == 81 and "0" not in sol_line
+
+
+def test_solve_batch_bad_body(server):
+    assert _request(server, "/solve_batch", {"boards": [[1, 2]]})[0] == 400
+    assert _request(server, "/solve_batch", {"nope": True})[0] == 400
+
+
 def test_engine_batches_concurrent_jobs():
     engine = SolverEngine(config=SMALL, max_batch=8, batch_window_s=0.05).start()
     try:

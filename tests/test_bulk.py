@@ -33,6 +33,55 @@ def test_bulk_chunking_is_invisible():
     np.testing.assert_array_equal(a.solved, b.solved)
 
 
+def test_bulk_stepped_rungs_match_defaults():
+    """Force every board through the escalation rungs (first_pass_steps=1)
+    with tiny bounded-step dispatches: the stepped rung driver must produce
+    exactly the default pipeline's verdicts and solutions.  This is the
+    regression net for the watchdog fix — straggler searches advance in
+    dispatch_steps chunks instead of one unbounded while_loop dispatch."""
+    grids = _corpus(n_gen=6)
+    ref = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=32))
+    stepped = solve_bulk(
+        grids,
+        SUDOKU_9,
+        BulkConfig(
+            chunk=32,
+            first_pass_steps=1,
+            dispatch_steps=3,
+            rungs=((64, 2, 32), (64, 8, 64)),
+        ),
+    )
+    np.testing.assert_array_equal(ref.solved, stepped.solved)
+    np.testing.assert_array_equal(ref.unsat, stepped.unsat)
+    np.testing.assert_array_equal(ref.solution, stepped.solution)
+
+
+def test_bulk_rung_stack_budget_caps_gang_width():
+    """A giant-geometry rung must narrow its gang to fit the stack budget
+    (naive 9x9-tuned widths compile multi-GB stacks that crash the TPU
+    compiler); verdicts stay correct at the narrowed width."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_16
+
+    grids = puzzle_batch(
+        SUDOKU_16, 4, seed=3, n_clues=150, unique=False
+    ).astype(np.int32)
+    res = solve_bulk(
+        grids,
+        SUDOKU_16,
+        BulkConfig(
+            chunk=4,
+            first_pass_steps=1,  # force the rungs
+            rungs=((64, 64, 256),),  # would be 1.07 GB at full width
+            rung_stack_mb=64,  # forces lanes_per_job down to fit
+            stack_slots=8,
+        ),
+    )
+    assert res.solved.all()
+    for g, s in zip(grids, res.solution):
+        assert is_valid_solution(s, SUDOKU_16)
+        assert ((g == 0) | (s == g)).all()
+
+
 def test_bulk_reports_unsat():
     bad = np.stack([EASY_9, EASY_9]).astype(np.int32)
     bad[1, 0, 2] = 5  # row already holds a 5 -> contradiction

@@ -135,8 +135,9 @@ def test_snapshot_and_resume_roots(engine):
             if j.done.is_set():
                 break
         assert snap is not None, "no snapshot while job in flight"
-        rows, nodes, shed_parts = snap
+        rows, nodes, shed_parts, job_cfg = snap
         assert shed_parts == 0
+        assert job_cfg["branch"] == SMALL.branch  # config rides the snapshot
         assert rows.ndim == 3 and rows.shape[0] >= 1
         assert j.wait(120) and j.solved
         # Re-entering the snapshot reproduces the same solution.
@@ -169,7 +170,8 @@ def test_shed_work_marks_exhaustion_unreliable():
             shed = eng.shed_work(k=2, timeout=5)
         if shed is None:
             pytest.skip("search resolved before any stack rows appeared")
-        uuid, rows = shed
+        uuid, rows, job_cfg = shed
+        assert job_cfg["branch"] == "first"  # the job's config rides the shed
         assert uuid == j.uuid and rows.shape[0] >= 1
         assert j.wait(120)
         assert j.shed_parts == 1

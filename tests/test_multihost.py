@@ -1,0 +1,68 @@
+"""Multi-process control plane (VERDICT r1 #7): two OS processes, each with
+its own ``jax.distributed`` runtime, form the ring over real TCP, dispatch
+jobs across the process boundary, and survive a hard kill — closing the
+round-1 "loopback threads only" caveat.  The reference's own deployment
+model was multiple OS processes (SURVEY.md §4); this automates it.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster_with_jax_distributed(tmp_path):
+    coord, p0, p1 = _free_port(), _free_port(), _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU tunnel in child processes
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    script = os.path.join(os.path.dirname(__file__), "multihost_script.py")
+    args = [sys.executable, script]
+    tail = [str(coord), str(p0), str(p1), str(tmp_path)]
+    procs = [
+        subprocess.Popen(
+            [*args, str(role), *tail],
+            env=env,
+            cwd=repo_root,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for role in (0, 1)
+    ]
+    try:
+        out0, _ = procs[0].communicate(timeout=240)
+        # role 1 dies by design (os._exit(9)) — only reap it.
+        out1, _ = procs[1].communicate(timeout=30)
+        debug = (
+            f"--- role0 ---\n{out0.decode(errors='replace')[-3000:]}\n"
+            f"--- role1 ---\n{out1.decode(errors='replace')[-3000:]}"
+        )
+        assert procs[0].returncode == 0, debug
+
+        with open(tmp_path / "result0.json") as f:
+            res = json.load(f)
+        assert res["process_count"] == 2
+        assert res["ring_formed"], debug
+        assert res["all_solved"]
+        assert res["peer_validations"] > 0, "no job ran on the peer process"
+        assert res["peer_removed"], "dead peer never evicted from the view"
+        assert res["post_kill_solved"]
+        with open(tmp_path / "result1.json") as f:
+            assert json.load(f)["joined"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -1,0 +1,99 @@
+"""TPU-hardware regression lane (VERDICT r1 #8 / ROADMAP r1 #9).
+
+Run with ``TPU_TESTS=1 python -m pytest tests -m tpu -q`` on a machine with
+a real TPU attached.  The default (CPU-mesh) suite exercises the identical
+Pallas kernel code in *interpret* mode; this lane compiles it through
+Mosaic on hardware, so a lowering regression fails here instead of shipping
+silently.  Every assertion is a bit-exactness check against the XLA
+reference path computed on the same device.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+requires_tpu = pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="needs a real TPU backend (run with TPU_TESTS=1 on TPU hardware)",
+)
+
+pytestmark = [pytest.mark.tpu, requires_tpu]
+
+
+def test_mosaic_sweep_matches_xla_on_device():
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import sweep_mosaic
+    from distributed_sudoku_solver_tpu.ops.propagate import propagate_sweep
+
+    rng = np.random.default_rng(7)
+    cand = jnp.asarray(
+        rng.integers(0, SUDOKU_9.full_mask + 1, size=(256, 9, 9), dtype=np.uint32)
+    )
+    ref = propagate_sweep(cand, SUDOKU_9)
+    got = sweep_mosaic(cand, SUDOKU_9)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.parametrize("rules", ["basic", "extended"])
+def test_fixpoint_kernel_on_device(rules):
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+        propagate_fixpoint_pallas,
+    )
+    from distributed_sudoku_solver_tpu.ops.propagate import propagate
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+    grids = np.stack([EASY_9, *HARD_9] * 48)[:256].astype(np.int32)
+    cand = encode_grid(jnp.asarray(grids), SUDOKU_9)
+    ref, _ = propagate(cand, SUDOKU_9, rules=rules)
+    got, _ = propagate_fixpoint_pallas(cand, SUDOKU_9, rules=rules)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.parametrize("propagator", ["pallas", "slices"])
+def test_solve_batch_propagators_on_device(propagator):
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    grids = jnp.asarray(np.stack(HARD_9).astype(np.int32))
+    ref = solve_batch(grids, SUDOKU_9, SolverConfig(min_lanes=64, stack_slots=16))
+    got = solve_batch(
+        grids,
+        SUDOKU_9,
+        SolverConfig(min_lanes=64, stack_slots=16, propagator=propagator),
+    )
+    np.testing.assert_array_equal(np.asarray(ref.solved), np.asarray(got.solved))
+    np.testing.assert_array_equal(np.asarray(ref.solution), np.asarray(got.solution))
+    np.testing.assert_array_equal(np.asarray(ref.nodes), np.asarray(got.nodes))
+
+
+def test_wire_roundtrip_on_device():
+    """The bulk pipeline's packed wire format, end to end on hardware."""
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops import wire
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch_wire
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    grids = np.stack(HARD_9).astype(np.int32)
+    packed = jnp.asarray(wire.pack_grids_host(grids, SUDOKU_9))
+    out = solve_batch_wire(
+        packed, SUDOKU_9, SolverConfig(min_lanes=len(grids), stack_slots=12)
+    )
+    sol, solved, unsat, _ = wire.unpack_result_host(np.asarray(out), SUDOKU_9)
+    assert solved.all() and not unsat.any()
+    for i in range(len(grids)):
+        assert is_valid_solution(sol[i])
