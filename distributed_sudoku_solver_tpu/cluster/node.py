@@ -600,14 +600,34 @@ class ClusterNode:
             self.engine.cancel(p)
 
     # -- job dispatch --------------------------------------------------------
-    def submit(self, grid) -> Job:
+    def submit(self, grid, config=None) -> Job:
+        """Dispatch one job to the least-loaded member; ``config`` optionally
+        overrides the solver strategy for this job (rides the TASK)."""
         g = np.asarray(grid, dtype=np.int32)
         if g.ndim != 2 or g.shape[0] != g.shape[1]:
             raise ValueError(f"grid must be square, got {g.shape}")
         member = self._pick_member()
         if member == self.addr_s:
-            return self._submit_local(g)
-        return self._submit_remote(g, member)
+            return self._submit_local(g, config=config)
+        return self._submit_remote(g, member, config=config)
+
+    def race(self, grid, configs, timeout: Optional[float] = None):
+        """Cluster-level portfolio: one racer per config, spread over the
+        least-loaded members; the first verdict cancels every other racer
+        (local purge + CANCEL to its executing member and any shed parts).
+
+        The fleet analog of ``serving/portfolio.race`` — where the reference
+        could only ever run its one recursive strategy per ring, a job here
+        races heterogeneous strategies across *machines*, SOLUTION-style
+        first-win cancellation included (``/root/reference/DHT_Node.py:
+        348-387``).
+        """
+        from distributed_sudoku_solver_tpu.serving.portfolio import race_jobs
+
+        if not configs:
+            raise ValueError("portfolio needs at least one config")
+        jobs = [self.submit(grid, config=cfg) for cfg in configs]
+        return race_jobs(jobs, cancel=self.cancel, timeout=timeout)
 
     def cancel(self, job_uuid: str) -> None:
         self._on_cancel(job_uuid)
@@ -633,7 +653,7 @@ class ClusterNode:
         with self._lock:
             self._outstanding[member] = self._outstanding.get(member, 0) + delta
 
-    def _submit_local(self, g: np.ndarray) -> Job:
+    def _submit_local(self, g: np.ndarray, config=None) -> Job:
         geom = geometry_for_size(g.shape[0])
         ju = str(uuid_mod.uuid4())
         handle = Job(uuid=ju, grid=g, geom=geom)
@@ -643,14 +663,20 @@ class ClusterNode:
             self._track(self.addr_s, -1)
             self._apply_result(handle, r)
 
-        self._start_exec(fin, grid=g, job_uuid=ju)
+        self._start_exec(fin, grid=g, job_uuid=ju, config=config)
         return handle
 
-    def _submit_remote(self, g: np.ndarray, member: str) -> Job:
+    def _submit_remote(self, g: np.ndarray, member: str, config=None) -> Job:
         geom = geometry_for_size(g.shape[0])
         job = Job(uuid=f"{self.addr_s}/{time.monotonic_ns()}", grid=g, geom=geom)
+        cfg_dict = dataclasses.asdict(config) if config is not None else None
         with self._lock:
-            self._ledger[job.uuid] = {"grid": g, "member": member, "job": job}
+            self._ledger[job.uuid] = {
+                "grid": g,
+                "member": member,
+                "job": job,
+                "config": cfg_dict,
+            }
         self._track(member, +1)
         try:
             wire.send_msg(
@@ -660,6 +686,7 @@ class ClusterNode:
                     "uuid": job.uuid,
                     "grid": g.tolist(),
                     "origin": self.addr_s,
+                    "config": cfg_dict,
                 },
                 self.config.io_timeout_s,
             )
@@ -702,7 +729,12 @@ class ClusterNode:
                 config=_config_from_dict(entry.get("config")),
             )
         else:
-            self._start_exec(fin, grid=entry["grid"], job_uuid=job_uuid)
+            self._start_exec(
+                fin,
+                grid=entry["grid"],
+                job_uuid=job_uuid,
+                config=_config_from_dict(entry.get("config")),
+            )
 
     def _on_task(self, msg: dict) -> None:
         grid = np.asarray(msg["grid"], dtype=np.int32)
@@ -715,6 +747,7 @@ class ClusterNode:
                 "uuid": ju,
                 "solved": r["solved"],
                 "unsat": r["unsat"],
+                "cancelled": r["cancelled"],
                 "nodes": r["nodes"],
                 "error": r["error"],
                 "solution": r["solution"].tolist()
@@ -728,7 +761,9 @@ class ClusterNode:
             except WireError:
                 pass  # origin died; its successor's repair already re-executed
 
-        ex = self._start_exec(fin, grid=grid, job_uuid=ju)
+        ex = self._start_exec(
+            fin, grid=grid, job_uuid=ju, config=_config_from_dict(msg.get("config"))
+        )
         if self.config.progress_interval_s > 0:
             threading.Thread(
                 target=self._progress_loop,
@@ -869,6 +904,7 @@ class ClusterNode:
         handle: Job = entry["job"]
         handle.solved = bool(msg["solved"])
         handle.unsat = bool(msg["unsat"])
+        handle.cancelled = bool(msg.get("cancelled", False))
         handle.nodes = int(msg["nodes"])
         handle.error = msg.get("error")
         if msg["solution"] is not None:

@@ -43,6 +43,48 @@ class PortfolioResult:
     duration_s: float
 
 
+def race_jobs(
+    jobs: list,
+    cancel,
+    timeout: Optional[float] = None,
+    start: Optional[float] = None,
+) -> PortfolioResult:
+    """First-verdict-wins over already-submitted racer jobs.
+
+    ``cancel(uuid)`` is called for every loser still running — on an engine
+    that is :meth:`SolverEngine.cancel` (mid-flight purge within one chunk),
+    on a cluster node it is :meth:`ClusterNode.cancel` (local purge + CANCEL
+    to the executing member, which also fans out to any shed parts).
+
+    Short-interval poll over the racers' events: verdicts arrive at chunk
+    granularity (>= ms), so a 10 ms poll adds no meaningful latency and no
+    per-race thread churn.
+    """
+    start = time.monotonic() if start is None else start
+    deadline = None if timeout is None else start + timeout
+    winner, winner_index = None, -1
+    while winner is None:
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        for i, job in enumerate(jobs):
+            if job.done.is_set() and (job.solved or job.unsat):
+                winner, winner_index = job, i
+                break
+        if winner is None:
+            if all(j.done.is_set() for j in jobs):
+                break  # every racer resolved without a verdict (budget/overflow)
+            time.sleep(0.01)
+    for job in jobs:
+        if job is not winner and not job.done.is_set():
+            cancel(job.uuid)
+    return PortfolioResult(
+        winner=winner,
+        winner_index=winner_index,
+        jobs=jobs,
+        duration_s=time.monotonic() - start,
+    )
+
+
 def race(
     engine: SolverEngine,
     grid,
@@ -62,28 +104,4 @@ def race(
     jobs = [
         engine.submit(grid, geom=geom, config=cfg, job_uuid=None) for cfg in configs
     ]
-    # Short-interval poll over the racers' events: verdicts arrive at chunk
-    # granularity (>= ms), so a 10 ms poll adds no meaningful latency and no
-    # per-race thread churn.
-    deadline = None if timeout is None else start + timeout
-    winner, winner_index = None, -1
-    while winner is None:
-        if deadline is not None and time.monotonic() >= deadline:
-            break
-        for i, job in enumerate(jobs):
-            if job.done.is_set() and (job.solved or job.unsat):
-                winner, winner_index = job, i
-                break
-        if winner is None:
-            if all(j.done.is_set() for j in jobs):
-                break  # every racer resolved without a verdict (budget/overflow)
-            time.sleep(0.01)
-    for job in jobs:
-        if job is not winner and not job.done.is_set():
-            engine.cancel(job.uuid)
-    return PortfolioResult(
-        winner=winner,
-        winner_index=winner_index,
-        jobs=jobs,
-        duration_s=time.monotonic() - start,
-    )
+    return race_jobs(jobs, cancel=engine.cancel, timeout=timeout, start=start)

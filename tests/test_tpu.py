@@ -77,6 +77,75 @@ def test_solve_batch_propagators_on_device(propagator):
     np.testing.assert_array_equal(np.asarray(ref.nodes), np.asarray(got.nodes))
 
 
+def test_engine_flights_on_device():
+    """The chunked flight loop end-to-end on hardware: solve, mid-flight
+    snapshot, roots resume — the serving path the bench's p50 rides."""
+    import time
+
+    import numpy as np
+
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+    eng = SolverEngine(
+        config=SolverConfig(min_lanes=64, stack_slots=32), max_batch=8
+    ).start()
+    try:
+        jobs = [eng.submit(p) for p in (EASY_9, *HARD_9)]
+        for j in jobs:
+            assert j.wait(240)
+            assert j.solved, j.error
+            assert is_valid_solution(j.solution)
+        # Roots-resume flight compiles and solves on hardware too.
+        slow = SolverEngine(
+            config=SolverConfig(min_lanes=8, stack_slots=16),
+            chunk_steps=1,
+            handicap_s=0.2,
+        ).start()
+        try:
+            j = slow.submit(HARD_9[1])
+            snap = None
+            deadline = time.monotonic() + 120
+            while snap is None and time.monotonic() < deadline:
+                if j.done.is_set():
+                    break
+                snap = slow.snapshot_rows(j.uuid, timeout=10)
+            assert j.wait(240)
+            if snap is not None:
+                jr = eng.submit_roots(snap[0], j.geom)
+                assert jr.wait(240)
+                assert jr.solved
+                np.testing.assert_array_equal(jr.solution, j.solution)
+        finally:
+            slow.stop(timeout=2)
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_bulk_stepped_rungs_on_device():
+    """Dispatch-time bounds on hardware: force stragglers through the
+    stepped escalation rungs (the watchdog-fix path) and cross-check the
+    default pipeline."""
+    import numpy as np
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+    grids = np.stack([EASY_9, *HARD_9]).astype(np.int32)
+    ref = solve_bulk(grids, SUDOKU_9, BulkConfig(chunk=8))
+    stepped = solve_bulk(
+        grids,
+        SUDOKU_9,
+        BulkConfig(chunk=8, first_pass_steps=1, dispatch_steps=4),
+    )
+    np.testing.assert_array_equal(ref.solved, stepped.solved)
+    np.testing.assert_array_equal(ref.solution, stepped.solution)
+    assert stepped.solved.all()
+
+
 def test_wire_roundtrip_on_device():
     """The bulk pipeline's packed wire format, end to end on hardware."""
     import jax.numpy as jnp
