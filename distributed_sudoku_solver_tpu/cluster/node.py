@@ -626,8 +626,11 @@ class ClusterNode:
 
         if not configs:
             raise ValueError("portfolio needs at least one config")
+        # Clock starts before the (blocking, wire-bound) submissions so the
+        # caller's timeout bounds the whole race, not just the wait.
+        start = time.monotonic()
         jobs = [self.submit(grid, config=cfg) for cfg in configs]
-        return race_jobs(jobs, cancel=self.cancel, timeout=timeout)
+        return race_jobs(jobs, cancel=self.cancel, timeout=timeout, start=start)
 
     def cancel(self, job_uuid: str) -> None:
         self._on_cancel(job_uuid)

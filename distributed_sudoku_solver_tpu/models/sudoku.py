@@ -99,6 +99,32 @@ class SudokuCSP:
         rest = jnp.where(onehot, states & ~pick, states)
         return guess, rest
 
+    def branch3(self, states: jax.Array):
+        """Three-way split of the branch cell: two singleton children + rest.
+
+        ``(guess, second, rest3, has_rest3)`` where guess carries the lowest
+        candidate digit, ``second`` the next-lowest as its own *singleton*
+        child (immediately propagation-ready for a thief, no re-split step),
+        and ``rest3`` the remaining candidates (``has_rest3`` False when the
+        cell had exactly two — rest3 is then an empty-cell contradiction and
+        must not be pushed).  Exploration order under LIFO (push rest3 then
+        second) is ascending digits, like the binary scheme; the *pruning*
+        can differ slightly (a binary rest-blob propagates as one state), so
+        ``branch_k=3`` is a distinct deterministic strategy, not a bit-exact
+        re-encoding of ``branch_k=2``.
+        """
+        onehot = self._branch_cell_onehot(states)
+        pick_low = self.branch_rule != "minrem-desc"
+        b1 = lowest_bit(states) if pick_low else highest_bit(states)
+        rem1 = states & ~b1
+        b2 = lowest_bit(rem1) if pick_low else highest_bit(rem1)
+        rem2 = rem1 & ~b2
+        guess = jnp.where(onehot, b1, states)
+        second = jnp.where(onehot, b2, states)
+        rest3 = jnp.where(onehot, rem2, states)
+        has_rest3 = jnp.any(onehot & (rem2 != 0), axis=(-1, -2))
+        return guess, second, rest3, has_rest3
+
     def _branch_cell_onehot(self, cand: jax.Array) -> jax.Array:
         """bool[L, n, n] one-hot of the cell to branch on per board."""
         n = self.geom.n

@@ -75,12 +75,19 @@ class SolverConfig:
     #   hidden singles) | 'extended' (+ box-line reductions, all backends)
     propagator: str = "xla"  # 'xla' | 'pallas' (VMEM kernel; batch solves only
     #   — the board-sharded path has its own collective sweep and rejects it)
+    branch_k: int = 2  # 2 = binary guess-vs-rest; 3 = two singleton children
+    #   + rest per expansion (shallower stacks, thief-ready second child;
+    #   requires the problem to implement branch3 — Sudoku does)
     steal: bool = True  # receiver-initiated work stealing between lanes
     steal_rounds: int = 1  # pairings per step; >1 ramps idle gangs up faster
     #   (a donor serves one thief per round, so a lone rich lane feeds at
     #   most `steal_rounds` thieves per step — matters for wide-lane few-job
     #   gang search, where 1 round means linear rather than quick fan-out)
     ring_steal_k: int = 8  # max boards shipped per step per chip pair (sharded)
+
+    def __post_init__(self) -> None:
+        if self.branch_k not in (2, 3):
+            raise ValueError(f"branch_k must be 2 or 3, got {self.branch_k}")
 
     def resolve_lanes(self, n_jobs: int) -> int:
         lanes = self.lanes if self.lanes > 0 else max(n_jobs, self.min_lanes)
@@ -402,17 +409,44 @@ def frontier_step(
     solution = jnp.where(newly[:, None, None], sol_rows, state.solution)
     solved = state.solved | newly
 
-    # --- branch: guess becomes the new top, `rest` is pushed ----------------
-    guess, rest = problem.branch(tops)
+    # --- branch: guess becomes the new top, sibling rows are pushed ---------
+    if config.branch_k == 3 and not hasattr(problem, "branch3"):
+        # A silent binary fallback would mislabel A/B measurements.
+        raise ValueError(
+            f"branch_k=3 requires the problem to implement branch3; "
+            f"{type(problem).__name__} does not"
+        )
+    if config.branch_k == 3:
+        # Two pushes per expansion (rest first, then the second singleton:
+        # LIFO pops ascending).  The second child being a *singleton* means
+        # a thief that steals it starts propagating immediately instead of
+        # spending a step re-splitting a rest blob.
+        guess, second, rest3, has_rest3 = problem.branch3(tops)
+        push_a = undecided & has_rest3 & (count < s)
+        slot_a = (state.base + count) % s
+        stack = state.stack.at[
+            jnp.where(push_a, lane_idx, n_lanes), jnp.clip(slot_a, 0, s - 1)
+        ].set(rest3, mode="drop")
+        count_a = count + push_a.astype(jnp.int32)
+        push_b = undecided & (count_a < s)
+        slot_b = (state.base + count_a) % s
+        stack = stack.at[
+            jnp.where(push_b, lane_idx, n_lanes), jnp.clip(slot_b, 0, s - 1)
+        ].set(second, mode="drop")
+        can_push = push_b  # the guess survives regardless; see overflow below
+        count = count_a  # push_b accounted via can_push in the update below
+        overflow_now = undecided & (~push_b | (has_rest3 & ~push_a))
+    else:
+        guess, rest = problem.branch(tops)
 
-    can_push = undecided & (count < s)
-    push_slot = (state.base + count) % s
-    stack = state.stack.at[
-        jnp.where(can_push, lane_idx, n_lanes), jnp.clip(push_slot, 0, s - 1)
-    ].set(rest, mode="drop")
+        can_push = undecided & (count < s)
+        push_slot = (state.base + count) % s
+        stack = state.stack.at[
+            jnp.where(can_push, lane_idx, n_lanes), jnp.clip(push_slot, 0, s - 1)
+        ].set(rest, mode="drop")
 
-    # On overflow: keep DFS-ing the guess in place; the rest-subtree is lost.
-    overflow_now = undecided & ~can_push
+        # On overflow: keep DFS-ing the guess in place; the rest-subtree is lost.
+        overflow_now = undecided & ~can_push
     overflowed = state.overflowed.at[
         jnp.where(overflow_now, state.job, n_jobs)
     ].set(True, mode="drop")

@@ -149,6 +149,72 @@ def test_snapshot_and_resume_roots(engine):
         slow.stop(timeout=2)
 
 
+def test_concurrent_control_surface_stress():
+    """Race-discipline stress (SURVEY.md §5.2): many threads hammering
+    submit/cancel/snapshot/shed/run_exclusive against live flights.  The
+    single-owner loop + control mailbox must neither deadlock nor lose a
+    job: every submitted job resolves, every control call returns, and the
+    engine still serves afterwards."""
+    import random
+    import threading
+
+    eng = SolverEngine(config=SMALL, max_batch=16, chunk_steps=2).start()
+    try:
+        stop = time.monotonic() + 6.0
+        jobs: list = []
+        jobs_lock = threading.Lock()
+        errors: list = []
+
+        def submitter():
+            rng = random.Random(threading.get_ident())
+            while time.monotonic() < stop:
+                j = eng.submit(HARD_9[rng.randrange(len(HARD_9))])
+                with jobs_lock:
+                    jobs.append(j)
+                if rng.random() < 0.3:
+                    eng.cancel(j.uuid)
+                time.sleep(rng.random() * 0.02)
+
+        def controller():
+            rng = random.Random(threading.get_ident() * 31)
+            while time.monotonic() < stop:
+                try:
+                    op = rng.random()
+                    if op < 0.4:
+                        with jobs_lock:
+                            j = jobs[rng.randrange(len(jobs))] if jobs else None
+                        if j is not None:
+                            eng.snapshot_rows(j.uuid, timeout=1.0)
+                    elif op < 0.7:
+                        eng.shed_work(k=2, timeout=1.0)
+                    else:
+                        eng.run_exclusive(lambda: 42, timeout=1.0)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                time.sleep(rng.random() * 0.01)
+
+        threads = [threading.Thread(target=submitter) for _ in range(3)] + [
+            threading.Thread(target=controller) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive(), "stress thread wedged"
+        assert not errors, errors[:3]
+        with jobs_lock:
+            all_jobs = list(jobs)
+        assert all_jobs, "stress submitted nothing"
+        for j in all_jobs:
+            assert j.wait(120), f"job lost under stress: {j.uuid}"
+            assert j.solved or j.cancelled or j.exhausted or j.error, j.uuid
+        # Still serving after the storm.
+        final = eng.submit(EASY_9)
+        assert final.wait(60) and final.solved
+    finally:
+        eng.stop(timeout=2)
+
+
 def test_shed_work_marks_exhaustion_unreliable():
     # Shedding removes subtrees, so a later local exhaustion must not be
     # reported as proven-unsat (the cluster layer aggregates parts first).
