@@ -137,8 +137,34 @@ def solve_file_main(args) -> None:
     print(json.dumps(stats))
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: a fresh CLI process reuses compiled
+    programs from any earlier run (first compile of the bulk shapes costs
+    ~20-40 s; warm processes skip it entirely)."""
+    import os
+
+    import jax
+
+    cache = os.environ.get(
+        "DSST_XLA_CACHE",
+        # User cache dir, not the package tree: an installed distribution's
+        # site-packages is often read-only (cache silently never persists)
+        # or shared (root-owned pollution).
+        os.path.join(
+            os.environ.get(
+                "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+            ),
+            "distributed_sudoku_solver_tpu",
+            "xla",
+        ),
+    )
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    _enable_compile_cache()
     if getattr(args, "cmd", None) == "solve-file":
         solve_file_main(args)
         return
