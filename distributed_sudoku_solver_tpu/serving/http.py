@@ -11,6 +11,11 @@ DHT_Node.py:540-614`` (SudokuHandler):
 Superset endpoints (absent from the reference):
 
 * ``GET /metrics`` — latency percentiles, batch sizes, device info.
+* ``POST /solve`` with ``"portfolio": true`` — race the default strategy
+  portfolio (``serving/portfolio.DEFAULT_PORTFOLIO``) on the board; the
+  first verdict wins and cancels the losers (on a cluster node the racers
+  spread across members).  Response adds ``"strategy"``: the winning
+  config's branch rule.
 * ``POST /solve_batch`` — bulk solving over HTTP, routed through the
   ``ops/bulk`` one-dispatch pipeline.  Body either
   ``{"boards": [[[...]], ...]}`` (nested int grids) or
@@ -61,28 +66,80 @@ class _Handler(BaseHTTPRequestHandler):
         node = self.server.solver_node
         import time
 
+        import numpy as np
+
+        # Validate the grid up front: the portfolio path submits straight to
+        # the engine, which must never see a malformed body.
+        g = np.asarray(grid)
+        if g.ndim != 2 or g.shape[0] != g.shape[1] or g.shape[0] < 1:
+            return self._send(
+                400, {"error": f"sudoku must be a square grid, got shape {g.shape}"}
+            )
         start = time.time()
-        try:
-            job = node.submit(grid)
-        except ValueError as e:
-            return self._send(400, {"error": str(e)})
         timeout = self.server.solve_timeout_s
-        if not job.wait(timeout):
-            node.cancel(job.uuid)
-            return self._send(504, {"error": "solve timed out", "uuid": job.uuid})
+        strategy = None
+        if payload.get("portfolio"):
+            try:
+                res = self._race(node, grid, timeout)
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+            if res.winner is None:
+                if all(j.done.is_set() for j in res.jobs):
+                    # Every racer resolved without a verdict: a permanent
+                    # budget/overflow failure, not a retryable timeout.
+                    err = next(
+                        (j.error for j in res.jobs if j.error), None
+                    )
+                    return self._send(
+                        500, {"error": err or "search budget exhausted"}
+                    )
+                return self._send(504, {"error": "portfolio race timed out"})
+            job = res.winner
+            strategy = res.strategy
+        else:
+            try:
+                job = node.submit(grid)
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+            if not job.wait(timeout):
+                node.cancel(job.uuid)
+                return self._send(504, {"error": "solve timed out", "uuid": job.uuid})
         duration = time.time() - start
+        extra = {"strategy": strategy} if strategy is not None else {}
         if job.solved:
             return self._send(
-                201, {"solution": job.solution.tolist(), "duration": duration}
+                201,
+                {"solution": job.solution.tolist(), "duration": duration, **extra},
             )
         if job.unsat:
             return self._send(
-                422, {"error": "puzzle is unsatisfiable", "duration": duration}
+                422,
+                {"error": "puzzle is unsatisfiable", "duration": duration, **extra},
             )
         return self._send(
             500,
             {"error": job.error or "search budget exhausted", "duration": duration},
         )
+
+    @staticmethod
+    def _race(node, grid, timeout):
+        """Race the default portfolio; result gains a ``strategy`` attr
+        (the winning config's branch rule, None when nobody won)."""
+        from distributed_sudoku_solver_tpu.serving.portfolio import (
+            DEFAULT_PORTFOLIO,
+            race,
+        )
+
+        if hasattr(node, "race"):  # cluster node: racers spread over members
+            res = node.race(grid, DEFAULT_PORTFOLIO, timeout=timeout)
+        else:
+            res = race(node.engine, grid, DEFAULT_PORTFOLIO, timeout=timeout)
+        res.strategy = (
+            DEFAULT_PORTFOLIO[res.winner_index].branch
+            if res.winner is not None
+            else None
+        )
+        return res
 
     def _solve_batch(self):
         import time

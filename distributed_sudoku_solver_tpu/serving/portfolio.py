@@ -41,6 +41,7 @@ class PortfolioResult:
     winner_index: int  # index into `configs` (-1 if no winner)
     jobs: list  # every racer's Job, same order as `configs`
     duration_s: float
+    strategy: Optional[str] = None  # winning branch rule (set by the HTTP layer)
 
 
 def race_jobs(

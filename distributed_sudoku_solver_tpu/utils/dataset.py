@@ -324,6 +324,10 @@ def solve_file(
             if writer_t is not None and writer_t.is_alive():
                 write_q.put(None)
                 writer_t.join(10)
-            out_f.close()  # keep tmp + progress: the next run resumes them
+            if writer_t is None or not writer_t.is_alive():
+                out_f.close()  # keep tmp + progress: the next run resumes them
+            # else: writer is wedged mid-write (e.g. a stalled fsync) — leave
+            # the fd to it rather than close under an in-progress write; the
+            # sidecar's bytes_done keeps any later resume byte-exact.
     stats["unresolved"] = stats["total"] - stats["solved"] - stats["unsat"]
     return stats

@@ -85,6 +85,29 @@ def test_unknown_paths(server):
     assert _request(server, "/nope")[0] == 404
 
 
+def test_solve_portfolio_option(server):
+    """POST /solve with portfolio=true races the default strategy portfolio
+    and reports the winning branch rule."""
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    status, body = _request(
+        server,
+        "/solve",
+        {"sudoku": np.asarray(HARD_9[0]).tolist(), "portfolio": True},
+    )
+    assert status == 201
+    assert is_valid_solution(np.asarray(body["solution"]))
+    assert body["strategy"] in ("minrem", "minrem-desc", "first")
+
+    bad = np.asarray(EASY_9).copy()
+    bad[0, 0], bad[0, 1] = 5, 5
+    status, body = _request(
+        server, "/solve", {"sudoku": bad.tolist(), "portfolio": True}
+    )
+    assert status == 422
+    assert body["strategy"] in ("minrem", "minrem-desc", "first")
+
+
 def test_solve_batch_endpoint_boards(server):
     """POST /solve_batch with nested grids (VERDICT r1 #6): bulk over HTTP,
     routed through ops/bulk on the engine's device-owner thread."""

@@ -52,3 +52,46 @@ def test_bulk_verdicts_match_oracle_on_random_boards(seed):
             assert oracle_sol is None, f"board {i}: oracle disagrees on unsat"
         # neither solved nor unsat (budget exhausted) never happens at 9x9
         assert res.solved[i] or res.unsat[i], f"board {i}: unresolved"
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_strategy_matrix_verdicts_agree(seed):
+    """Every solver strategy is sound and complete, so on ANY board the
+    verdict (solved / unsat) must be identical across the whole strategy
+    matrix — branch rules, digit orders, branch_k, inference tiers — even
+    though the searches (and, on multi-solution boards, the returned
+    solutions) differ.  Each returned solution must be a valid completion
+    of its input."""
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+    boards = _random_boards(seed, 9)
+    configs = [
+        SolverConfig(min_lanes=8, stack_slots=32, branch="minrem"),
+        SolverConfig(min_lanes=8, stack_slots=32, branch="minrem-desc"),
+        SolverConfig(min_lanes=8, stack_slots=32, branch="first"),
+        SolverConfig(min_lanes=8, stack_slots=32, branch="mixed"),
+        SolverConfig(min_lanes=8, stack_slots=32, branch_k=3),
+        SolverConfig(min_lanes=8, stack_slots=32, rules="extended"),
+        SolverConfig(min_lanes=8, stack_slots=32, branch="minrem-desc", branch_k=3),
+    ]
+    results = [solve_batch(boards, SUDOKU_9, cfg) for cfg in configs]
+    ref_solved = np.asarray(results[0].solved)
+    ref_unsat = np.asarray(results[0].unsat)
+    for cfg, res in zip(configs, results):
+        np.testing.assert_array_equal(
+            np.asarray(res.solved), ref_solved, err_msg=f"solved mismatch: {cfg}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.unsat), ref_unsat, err_msg=f"unsat mismatch: {cfg}"
+        )
+        for i in range(len(boards)):
+            if ref_solved[i]:
+                s = np.asarray(res.solution[i])
+                assert is_valid_solution(s), f"{cfg} invalid solution {i}"
+                mask = boards[i] > 0
+                assert np.array_equal(s[mask], boards[i][mask])
+    # Cross-check the verdict against the oracle on every board.
+    for i in range(len(boards)):
+        oracle_sol = solve_oracle(boards[i], SUDOKU_9)
+        assert ref_solved[i] == (oracle_sol is not None)
