@@ -342,8 +342,9 @@ class ClusterNode:
                 conn.settimeout(self.config.io_timeout_s)
                 msg = wire.recv_msg(conn)
                 self._handle(msg, conn)
-            except (WireError, OSError, ValueError, KeyError) as e:
-                # Malformed or interrupted control traffic is logged-and-dropped;
+            except (WireError, OSError, ValueError, KeyError, RuntimeError) as e:
+                # Malformed or interrupted control traffic is logged-and-dropped
+                # (RuntimeError covers "engine stopped" during teardown);
                 # reliability comes from sender-side errors, not server retries.
                 if not self._stop.is_set():
                     print(f"[{self.addr_s}] bad message: {e!r}")
@@ -630,7 +631,10 @@ class ClusterNode:
         # caller's timeout bounds the whole race, not just the wait.
         start = time.monotonic()
         jobs = [self.submit(grid, config=cfg) for cfg in configs]
-        return race_jobs(jobs, cancel=self.cancel, timeout=timeout, start=start)
+        res = race_jobs(jobs, cancel=self.cancel, timeout=timeout, start=start)
+        if res.winner is not None:
+            res.strategy = configs[res.winner_index].branch
+        return res
 
     def cancel(self, job_uuid: str) -> None:
         self._on_cancel(job_uuid)

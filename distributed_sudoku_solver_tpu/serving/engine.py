@@ -182,6 +182,11 @@ class SolverEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
+        # Close the submit-vs-stop window: a producer that won the lock
+        # before this drain gets swept here; one that arrives after saw
+        # _stop (set before we took the lock) and raised in submit().
+        with self._lock:
+            self._drain_on_stop()
 
     # -- client API ----------------------------------------------------------
     def submit(
@@ -198,8 +203,17 @@ class SolverEngine:
         job = Job(
             uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom, config=config
         )
-        self._queue.put(job)
+        self._enqueue(job)
         return job
+
+    def _enqueue(self, job: Job) -> None:
+        # Lock-ordered with stop()'s final drain: either this put happens
+        # before the drain (and is swept by it), or _stop is already
+        # visible here and we fail fast instead of stranding the caller.
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("engine stopped")
+            self._queue.put(job)
 
     def submit_roots(
         self,
@@ -223,7 +237,7 @@ class SolverEngine:
             roots=r,
             config=config,
         )
-        self._queue.put(job)
+        self._enqueue(job)
         return job
 
     def cancel(self, job_uuid: str) -> None:
@@ -233,7 +247,10 @@ class SolverEngine:
                 self._cancelled.pop(next(iter(self._cancelled)))
 
     def _request(self, req: _Control, timeout: float):
-        self._control.put(req)
+        with self._lock:
+            if self._stop.is_set():
+                return None  # nobody will service it; fail fast, don't strand
+            self._control.put(req)
         if not req.done.wait(timeout):
             with req.lock:
                 if not req.done.is_set() and not req.claimed:
@@ -405,6 +422,31 @@ class SolverEngine:
                     continue
                 if finished:
                     self._flights.remove(fl)
+        self._drain_on_stop()
+
+    def _drain_on_stop(self) -> None:
+        """Resolve everything still pending when the loop exits: nobody else
+        will ever touch these jobs/controls, and an un-set event would hang
+        any caller waiting without a timeout."""
+        leftovers: list[Job] = []
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for fl in self._flights:
+            leftovers.extend(j for j in fl.jobs if not j.done.is_set())
+        self._flights.clear()
+        for job in leftovers:
+            if not job.done.is_set():
+                job.error = "engine stopped"
+                job.done.set()
+        while True:
+            try:
+                req = self._control.get_nowait()
+            except queue.Empty:
+                break
+            req.done.set()  # result stays None: caller sees "not serviced"
 
     # -- flight path (default) ----------------------------------------------
     def _launch_flights(

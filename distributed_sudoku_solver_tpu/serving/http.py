@@ -70,7 +70,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         # Validate the grid up front: the portfolio path submits straight to
         # the engine, which must never see a malformed body.
-        g = np.asarray(grid)
+        try:
+            g = np.asarray(grid)  # ragged lists raise ValueError here
+        except ValueError as e:
+            return self._send(400, {"error": f"bad sudoku grid: {e}"})
         if g.ndim != 2 or g.shape[0] != g.shape[1] or g.shape[0] < 1:
             return self._send(
                 400, {"error": f"sudoku must be a square grid, got shape {g.shape}"}
@@ -84,16 +87,12 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
             if res.winner is None:
-                if all(j.done.is_set() for j in res.jobs):
-                    # Every racer resolved without a verdict: a permanent
-                    # budget/overflow failure, not a retryable timeout.
-                    err = next(
-                        (j.error for j in res.jobs if j.error), None
-                    )
-                    return self._send(
-                        500, {"error": err or "search budget exhausted"}
-                    )
-                return self._send(504, {"error": "portfolio race timed out"})
+                if res.timed_out:
+                    return self._send(504, {"error": "portfolio race timed out"})
+                # Every racer resolved without a verdict: a permanent
+                # budget/overflow failure, not a retryable timeout.
+                err = next((j.error for j in res.jobs if j.error), None)
+                return self._send(500, {"error": err or "search budget exhausted"})
             job = res.winner
             strategy = res.strategy
         else:
@@ -123,23 +122,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _race(node, grid, timeout):
-        """Race the default portfolio; result gains a ``strategy`` attr
-        (the winning config's branch rule, None when nobody won)."""
+        """Race the default portfolio (strategy/timed_out are filled in by
+        the race itself, ``serving/portfolio.py``)."""
         from distributed_sudoku_solver_tpu.serving.portfolio import (
             DEFAULT_PORTFOLIO,
             race,
         )
 
         if hasattr(node, "race"):  # cluster node: racers spread over members
-            res = node.race(grid, DEFAULT_PORTFOLIO, timeout=timeout)
-        else:
-            res = race(node.engine, grid, DEFAULT_PORTFOLIO, timeout=timeout)
-        res.strategy = (
-            DEFAULT_PORTFOLIO[res.winner_index].branch
-            if res.winner is not None
-            else None
-        )
-        return res
+            return node.race(grid, DEFAULT_PORTFOLIO, timeout=timeout)
+        return race(node.engine, grid, DEFAULT_PORTFOLIO, timeout=timeout)
 
     def _solve_batch(self):
         import time

@@ -41,7 +41,11 @@ class PortfolioResult:
     winner_index: int  # index into `configs` (-1 if no winner)
     jobs: list  # every racer's Job, same order as `configs`
     duration_s: float
-    strategy: Optional[str] = None  # winning branch rule (set by the HTTP layer)
+    # With winner=None these disambiguate: timed_out=True means the deadline
+    # expired with racers still running (retryable); False means every racer
+    # resolved without a verdict (permanent budget/overflow failure).
+    timed_out: bool = False
+    strategy: Optional[str] = None  # winning config's branch rule
 
 
 def race_jobs(
@@ -64,8 +68,10 @@ def race_jobs(
     start = time.monotonic() if start is None else start
     deadline = None if timeout is None else start + timeout
     winner, winner_index = None, -1
+    timed_out = False
     while winner is None:
         if deadline is not None and time.monotonic() >= deadline:
+            timed_out = True
             break
         for i, job in enumerate(jobs):
             if job.done.is_set() and (job.solved or job.unsat):
@@ -83,6 +89,7 @@ def race_jobs(
         winner_index=winner_index,
         jobs=jobs,
         duration_s=time.monotonic() - start,
+        timed_out=timed_out,
     )
 
 
@@ -105,4 +112,7 @@ def race(
     jobs = [
         engine.submit(grid, geom=geom, config=cfg, job_uuid=None) for cfg in configs
     ]
-    return race_jobs(jobs, cancel=engine.cancel, timeout=timeout, start=start)
+    res = race_jobs(jobs, cancel=engine.cancel, timeout=timeout, start=start)
+    if res.winner is not None:
+        res.strategy = configs[res.winner_index].branch
+    return res

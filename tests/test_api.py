@@ -61,6 +61,14 @@ def test_solve_bad_body_returns_400(server):
     assert status == 400
     status, _ = _request(server, "/solve", {"sudoku": [[1, 2], [3, 4], [5, 6]]})
     assert status == 400
+    # Ragged rows: np.asarray raises; must be a clean 400, not a dropped
+    # connection — on both the plain and portfolio paths.
+    status, _ = _request(server, "/solve", {"sudoku": [[1, 2], [3]]})
+    assert status == 400
+    status, _ = _request(
+        server, "/solve", {"sudoku": [[1, 2], [3]], "portfolio": True}
+    )
+    assert status == 400
 
 
 def test_stats_shape(server):

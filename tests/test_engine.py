@@ -149,6 +149,58 @@ def test_snapshot_and_resume_roots(engine):
         slow.stop(timeout=2)
 
 
+def test_stop_drains_pending_jobs():
+    """Shutdown must resolve queued and in-flight jobs (error='engine
+    stopped'), never strand a caller waiting without a timeout."""
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, chunk_steps=1, handicap_s=0.2
+    ).start()
+    warm = eng.submit(EASY_9)
+    assert warm.wait(60)
+    inflight = eng.submit(HARD_9[1])  # long flight
+    assert wait_for(lambda: len(eng._flights) > 0, timeout=30)
+    queued = eng.submit(HARD_9[0])
+    eng.stop(timeout=10)
+    assert inflight.wait(5), "in-flight job stranded by stop()"
+    assert queued.wait(5), "queued job stranded by stop()"
+    for j in (inflight, queued):
+        assert j.done.is_set()
+        assert j.solved or j.error == "engine stopped"
+
+
+def test_flight_failure_resolves_jobs_and_loop_survives():
+    """A flight that cannot even launch (roots exceed a fixed-lanes
+    frontier's capacity) must fail its job with an error — and the device
+    loop must keep serving afterwards."""
+    eng = SolverEngine(
+        config=SolverConfig(lanes=2, stack_slots=4), max_batch=8
+    ).start()
+    try:
+        bad_roots = np.ones((2 * (1 + 4) + 1, 9, 9), np.uint32)  # > capacity
+        from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+
+        j = eng.submit_roots(bad_roots, geometry_for_size(9))
+        assert j.wait(60)
+        assert j.error and not j.solved
+        ok = eng.submit(EASY_9)
+        assert ok.wait(60) and ok.solved, "loop died after a failed flight"
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_legacy_solve_fn_failure_resolves_jobs():
+    def boom(grids, geom, cfg):
+        raise RuntimeError("backend exploded")
+
+    eng = SolverEngine(solve_fn=boom, batch_window_s=0.001).start()
+    try:
+        j = eng.submit(EASY_9)
+        assert j.wait(30)
+        assert j.error and "backend exploded" in j.error
+    finally:
+        eng.stop(timeout=2)
+
+
 def test_concurrent_control_surface_stress():
     """Race-discipline stress (SURVEY.md §5.2): many threads hammering
     submit/cancel/snapshot/shed/run_exclusive against live flights.  The
