@@ -960,6 +960,26 @@ class ClusterNode:
                 total_s += res["solved"]
         return {"all": {"solved": total_s, "validations": total_v}, "nodes": nodes}
 
+    def metrics_view(self) -> dict:
+        """Engine metrics + cluster-runtime counters (GET /metrics superset):
+        membership/view version, dispatch ledger, mid-job offload traffic,
+        and live local executions — the observability the reference's
+        print-trace never had (SURVEY.md §5.5)."""
+        body = self.engine.metrics()
+        with self._lock:
+            body["cluster"] = {
+                "address": self.addr_s,
+                "coordinator": self.coordinator,
+                "members": len(self.network),
+                "view": [self.net_term, self.net_epoch],
+                "ledger_outstanding": len(self._ledger),
+                "execs_running": len(self._execs),
+                "parts_running": len(self._parts),
+                "subtasks_sent": self.subtasks_sent,
+                "subtasks_run": self.subtasks_run,
+            }
+        return body
+
     def network_view(self) -> dict:
         """Reference `/network` shape (``DHT_Node.py:600-614``)."""
         with self._lock:

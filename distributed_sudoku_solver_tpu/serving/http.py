@@ -242,8 +242,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _metrics(node) -> dict:
-        engine = getattr(node, "engine", None)
-        body = engine.metrics() if engine is not None else {}
+        if hasattr(node, "metrics_view"):  # cluster node: + runtime counters
+            body = node.metrics_view()
+        else:
+            engine = getattr(node, "engine", None)
+            body = engine.metrics() if engine is not None else {}
         try:
             import jax
 
