@@ -21,7 +21,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
-from typing import Optional, Tuple
+from typing import Tuple
 
 Addr = Tuple[str, int]
 

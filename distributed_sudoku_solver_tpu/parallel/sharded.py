@@ -56,7 +56,7 @@ from distributed_sudoku_solver_tpu.ops.solve import (
     finalize_frontier,
     sudoku_csp,
 )
-from distributed_sudoku_solver_tpu.parallel.mesh import LANE_AXIS, default_mesh
+from distributed_sudoku_solver_tpu.parallel.mesh import default_mesh
 
 
 def _ring_steal(

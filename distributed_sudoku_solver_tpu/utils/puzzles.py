@@ -16,7 +16,7 @@ from typing import Optional
 
 import numpy as np
 
-from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
 from distributed_sudoku_solver_tpu.utils.oracle import count_solutions as _py_count
 
 

@@ -11,7 +11,6 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import Mesh
 
 from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9, SUDOKU_16, SUDOKU_25

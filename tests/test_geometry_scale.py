@@ -4,7 +4,6 @@
 made impossible.  One generic compiled kernel serves them all here."""
 
 import numpy as np
-import pytest
 
 from distributed_sudoku_solver_tpu import native
 from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_16, SUDOKU_25

@@ -12,8 +12,6 @@ import subprocess
 import sys
 import time
 
-import pytest
-
 
 def _free_port() -> int:
     with socket.socket() as s:

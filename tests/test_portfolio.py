@@ -8,7 +8,6 @@ twice — min-over-configs of a heavy-tailed cost beats every fixed config.
 """
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

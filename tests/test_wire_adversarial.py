@@ -18,11 +18,10 @@ import numpy as np
 import pytest
 
 from distributed_sudoku_solver_tpu.cluster import wire
-from distributed_sudoku_solver_tpu.cluster.node import ClusterConfig, ClusterNode
-from distributed_sudoku_solver_tpu.cluster.wire import WireError
+from distributed_sudoku_solver_tpu.cluster.node import ClusterNode
 from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
 
-from tests.test_cluster import FAST, make_node, oracle_solve_fn, wait_for
+from tests.test_cluster import make_node, wait_for
 
 
 def _raw_send(addr, payload: bytes) -> None:
