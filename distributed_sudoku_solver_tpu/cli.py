@@ -19,6 +19,7 @@ import time
 from distributed_sudoku_solver_tpu.cluster.node import ClusterConfig, ClusterNode
 from distributed_sudoku_solver_tpu.cluster.wire import parse_addr
 from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.propagate import RULE_TIERS
 from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
 from distributed_sudoku_solver_tpu.serving.http import ApiServer
 
@@ -46,9 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--stack-slots", type=int, default=64)
     ap.add_argument(
         "--rules",
-        choices=("basic", "extended"),
+        choices=RULE_TIERS,
         default="basic",
-        help="propagation strength (extended adds box-line reductions)",
+        help="propagation strength (extended adds box-line reductions, "
+        "subsets adds naked-subset eliminations)",
     )
     ap.add_argument(
         "--branch",
@@ -108,9 +110,10 @@ def build_solve_file_parser(sub) -> argparse.ArgumentParser:
     ap.add_argument("--batch", type=int, default=65536, help="boards per device batch")
     ap.add_argument(
         "--rules",
-        choices=("basic", "extended"),
+        choices=RULE_TIERS,
         default="extended",
-        help="propagation strength (extended adds box-line reductions)",
+        help="propagation strength (extended adds box-line reductions, "
+        "subsets adds naked-subset eliminations)",
     )
     return ap
 

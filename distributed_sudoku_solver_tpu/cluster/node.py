@@ -108,6 +108,12 @@ class ClusterConfig:
     shed_k: int = 8  # max stack rows shipped per SUBTASK
     progress_interval_s: float = 0.5  # worker -> origin snapshot cadence
     progress_max_rows: int = 4096  # skip snapshots larger than this
+    # Shed parts are retained at the shedder and re-entered locally when the
+    # part-executing peer leaves the network view (always on).  Optionally a
+    # wall-clock deadline also re-homes parts stuck on a wedged-but-alive
+    # peer; 0 disables (the failure detector covers actual deaths, and a
+    # deep search can legitimately run long).
+    part_deadline_s: float = 0.0
 
 
 class _Exec:
@@ -150,7 +156,12 @@ class _Exec:
         self.job.done.wait()
         self._maybe_finalize()
 
-    def add_part(self, part_uuid: str, peer: str) -> bool:
+    def add_part(
+        self, part_uuid: str, peer: str, rows_packed=None, config=None
+    ) -> bool:
+        """Register a shed part.  ``rows_packed``/``config`` are retained so
+        the shedder can re-enter the subtree locally if ``peer`` dies — the
+        recovery symmetry the worker-death resume path has (ADVICE r2 #1)."""
         with self.lock:
             if self.finalized:
                 return False
@@ -159,8 +170,55 @@ class _Exec:
                 "done": False,
                 "exhausted": False,
                 "nodes": 0,
+                "rows": rows_packed,
+                "config": config,
+                "t0": time.monotonic(),
+                "rehomed": False,
             }
             return True
+
+    def take_orphaned(self, live: set, deadline_s: float = 0.0) -> list:
+        """Claim parts whose peer left ``live`` (or blew ``deadline_s``) for
+        local re-execution: each is marked re-homed (so recovery fires once)
+        and its retained rows/config returned.  ``peer`` is kept as the
+        original executor so finalize still CANCELs a slow-but-alive peer
+        that blew the deadline.  A false death verdict at worst duplicates
+        the part's work — PART_RESULT first-wins dedupe keeps the aggregate
+        sound."""
+        now = time.monotonic()
+        out = []
+        with self.lock:
+            if self.finalized:
+                return out
+            for pu, p in self.parts.items():
+                if p["done"] or p["rehomed"] or p["rows"] is None:
+                    continue
+                if p["peer"] == self.node.addr_s:
+                    continue
+                dead = p["peer"] not in live
+                late = deadline_s > 0 and now - p["t0"] > deadline_s
+                if dead or late:
+                    p["rehomed"] = True
+                    out.append((pu, p["rows"], p["config"]))
+        return out
+
+    def mark_local(self, part_uuid: str) -> None:
+        """Record that a part runs on this node (the WireError shed
+        fallback), so view-change recovery never re-enters it."""
+        with self.lock:
+            p = self.parts.get(part_uuid)
+            if p is not None:
+                p["peer"] = self.node.addr_s
+                p["rehomed"] = True
+
+    def unmark_rehomed(self, part_uuid: str) -> None:
+        """Local re-entry failed: clear the flag so a later recovery pass
+        (next view change / deadline tick) can retry instead of the part
+        being permanently lost."""
+        with self.lock:
+            p = self.parts.get(part_uuid)
+            if p is not None:
+                p["rehomed"] = False
 
     def on_part_result(self, part_uuid: str, msg: dict) -> None:
         with self.lock:
@@ -170,6 +228,16 @@ class _Exec:
             info["done"] = True
             info["exhausted"] = bool(msg.get("unsat"))
             info["nodes"] = int(msg.get("nodes", 0))
+            peer, rehomed = info["peer"], info["rehomed"]
+        if rehomed:
+            # A re-homed part has two executions (the original peer may be
+            # alive — blown deadline / false death verdict — plus the local
+            # re-entry).  First result wins: cancel both executors so the
+            # loser doesn't burn an engine with no waiter (cancelling the
+            # finished one is a harmless no-op).
+            self.node._send_cancel(peer, part_uuid)
+            if peer != self.node.addr_s:
+                self.node._send_cancel(self.node.addr_s, part_uuid)
         if msg.get("solved") and msg.get("solution") is not None:
             self._finalize(
                 solved=True, solution=np.asarray(msg["solution"], dtype=np.int32)
@@ -211,10 +279,16 @@ class _Exec:
             self.finalized = True
             part_nodes = sum(p["nodes"] for p in self.parts.values())
             losers = [
-                (pu, p["peer"]) for pu, p in self.parts.items() if not p["done"]
+                (pu, p["peer"], p["rehomed"])
+                for pu, p in self.parts.items()
+                if not p["done"]
             ]
-        for part_uuid, peer in losers:
+        for part_uuid, peer, rehomed in losers:
             self.node._send_cancel(peer, part_uuid)
+            # A re-homed part has a *second* execution here (the original
+            # peer may be alive too, e.g. a blown deadline): cancel both.
+            if rehomed and peer != self.node.addr_s:
+                self.node._send_cancel(self.node.addr_s, part_uuid)
         self.on_final(
             {
                 "solved": solved,
@@ -390,6 +464,8 @@ class ClusterNode:
                 expired = time.monotonic() - self._last_hb > limit
             if expired and pred is not None:
                 self._on_peer_dead(pred)
+            if self.config.part_deadline_s > 0:
+                self._recover_parts()
 
     # -- message handling ----------------------------------------------------
     def _handle(self, msg: dict, conn: socket.socket) -> None:
@@ -491,6 +567,7 @@ class ClusterNode:
             ]
         for u in gone:
             self._reexecute(u)
+        self._recover_parts()
         if rejoin:
             try:
                 wire.send_msg(
@@ -516,6 +593,7 @@ class ClusterNode:
             self._broadcast_network()
             for u in gone:
                 self._reexecute(u)
+            self._recover_parts()
         else:
             try:
                 wire.send_msg(
@@ -670,7 +748,13 @@ class ClusterNode:
             self._track(self.addr_s, -1)
             self._apply_result(handle, r)
 
-        self._start_exec(fin, grid=g, job_uuid=ju, config=config)
+        try:
+            self._start_exec(fin, grid=g, job_uuid=ju, config=config)
+        except BaseException:
+            # submit can raise (e.g. "engine stopped"); un-count or the +1
+            # leaks and permanently skews least-outstanding placement.
+            self._track(self.addr_s, -1)
+            raise
         return handle
 
     def _submit_remote(self, g: np.ndarray, member: str, config=None) -> Job:
@@ -724,24 +808,34 @@ class ClusterNode:
             self._apply_result(handle, r)
 
         rows_packed = entry.get("rows")
-        if rows_packed is not None:
-            rows = unpack_rows(rows_packed)
-            geom = geometry_for_size(rows.shape[1])
-            self._start_exec(
-                fin,
-                roots=rows,
-                geom=geom,
-                job_uuid=job_uuid,
-                base_nodes=int(entry.get("nodes_done", 0)),
-                config=_config_from_dict(entry.get("config")),
-            )
-        else:
-            self._start_exec(
-                fin,
-                grid=entry["grid"],
-                job_uuid=job_uuid,
-                config=_config_from_dict(entry.get("config")),
-            )
+        try:
+            if rows_packed is not None:
+                rows = unpack_rows(rows_packed)
+                geom = geometry_for_size(rows.shape[1])
+                self._start_exec(
+                    fin,
+                    roots=rows,
+                    geom=geom,
+                    job_uuid=job_uuid,
+                    base_nodes=int(entry.get("nodes_done", 0)),
+                    config=_config_from_dict(entry.get("config")),
+                )
+            else:
+                self._start_exec(
+                    fin,
+                    grid=entry["grid"],
+                    job_uuid=job_uuid,
+                    config=_config_from_dict(entry.get("config")),
+                )
+        except Exception as e:
+            # Same counter leak as _submit_local, but swallow instead of
+            # re-raise: _reexecute runs on recovery paths inside _hb_loop
+            # (via _on_peer_dead -> _on_node_failed) and _on_update_network,
+            # where a raise would kill the heartbeat thread and stop failure
+            # detection entirely.  Fail the handle so waiters unblock.
+            self._track(self.addr_s, -1)
+            handle.error = f"re-execution failed: {e}"
+            handle.done.set()
 
     def _on_task(self, msg: dict) -> None:
         grid = np.asarray(msg["grid"], dtype=np.int32)
@@ -834,13 +928,14 @@ class ClusterNode:
         with self._lock:
             ex = self._execs.get(root_uuid)
         part_uuid = f"{root_uuid}#p{time.monotonic_ns()}"
-        if ex is None or not ex.add_part(part_uuid, requester):
+        rows_packed = pack_rows(rows)
+        if ex is None or not ex.add_part(part_uuid, requester, rows_packed, job_cfg):
             return  # job resolved while we were shedding; rows are moot
         payload = {
             "method": "SUBTASK",
             "part": part_uuid,
             "root": root_uuid,
-            "rows": pack_rows(rows),
+            "rows": rows_packed,
             "config": job_cfg,  # the part searches under the job's config
             "report_to": self.addr_s,
         }
@@ -851,7 +946,10 @@ class ClusterNode:
             self.subtasks_sent += 1
         except WireError:
             # Requester vanished between NEEDWORK and now: run the part
-            # ourselves so the shed subtrees are never lost.
+            # ourselves so the shed subtrees are never lost.  Mark it local
+            # first, or the requester's eviction from the view would make
+            # _recover_parts re-enter the same part uuid a second time.
+            ex.mark_local(part_uuid)
             self._on_subtask(payload)
 
     def _on_subtask(self, msg: dict) -> None:
@@ -895,6 +993,42 @@ class ClusterNode:
             job_uuid=part_uuid,
             config=_config_from_dict(msg.get("config")),
         )
+
+    def _recover_parts(self) -> None:
+        """Re-enter shed SUBTASK parts whose executing peer left the network
+        view (or blew the optional part deadline).
+
+        The rows were retained at shed time (:meth:`_Exec.add_part`), so the
+        lost subtree re-runs locally under the same part uuid — mirroring the
+        WireError fallback in :meth:`_on_needwork`.  Without this, the root
+        _Exec waits forever on a dead part: the job never finalizes on the
+        exhaustion path, and a solution in the lost subtree is never found
+        (ADVICE r2 #1)."""
+        with self._lock:
+            execs = list(self._execs.values())
+            live = set(self.network)
+        for ex in execs:
+            for part_uuid, rows_packed, cfg in ex.take_orphaned(
+                live, self.config.part_deadline_s
+            ):
+                try:
+                    self._on_subtask(
+                        {
+                            "part": part_uuid,
+                            "root": ex.uuid,
+                            "rows": rows_packed,
+                            "config": cfg,
+                            "report_to": self.addr_s,
+                        }
+                    )
+                except Exception as e:
+                    # Re-entry can raise (e.g. "engine stopped" mid-drain).
+                    # Clear the re-homed flag so a later pass retries, and
+                    # never let the raise kill the caller (_hb_loop would
+                    # stop heartbeating entirely).
+                    ex.unmark_rehomed(part_uuid)
+                    if not self._stop.is_set():
+                        print(f"[{self.addr_s}] part re-entry failed: {e!r}")
 
     def _on_part_result(self, msg: dict) -> None:
         with self._lock:

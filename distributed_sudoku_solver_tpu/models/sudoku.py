@@ -46,7 +46,9 @@ class SudokuCSP:
             raise ValueError(f"unknown branch rule {self.branch_rule!r}")
         if self.propagator not in ("xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
-        if self.rules not in ("basic", "extended"):
+        from distributed_sudoku_solver_tpu.ops.propagate import RULE_TIERS
+
+        if self.rules not in RULE_TIERS:
             raise ValueError(f"unknown rules {self.rules!r}")
 
     @property

@@ -80,7 +80,9 @@ class BulkConfig:
     def __post_init__(self) -> None:
         if self.propagator not in (None, "xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
-        if self.rules not in ("basic", "extended"):
+        from distributed_sudoku_solver_tpu.ops.propagate import RULE_TIERS
+
+        if self.rules not in RULE_TIERS:
             raise ValueError(f"unknown rules {self.rules!r}")
 
 

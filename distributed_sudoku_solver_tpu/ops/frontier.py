@@ -72,7 +72,8 @@ class SolverConfig:
     #   order, bit-exactness tests) | 'mixed' (per-state hash-diversified) |
     #   'minrem-desc' (MRV, descending digits — the portfolio-racing mirror)
     rules: str = "basic"  # propagation strength: 'basic' (elimination +
-    #   hidden singles) | 'extended' (+ box-line reductions, all backends)
+    #   hidden singles) | 'extended' (+ box-line reductions) | 'subsets'
+    #   (+ naked-subset eliminations, for deep search) — all backends
     propagator: str = "xla"  # 'xla' | 'pallas' (VMEM kernel; batch solves only
     #   — the board-sharded path has its own collective sweep and rejects it)
     branch_k: int = 2  # 2 = binary guess-vs-rest; 3 = two singleton children
@@ -307,12 +308,24 @@ def shed_rows(state: Frontier, job_id: jax.Array, k: int):
     job_live = (state.job == job_id) & ~state.solved[jnp.clip(state.job, 0, n_jobs - 1)]
     donor = job_live & (state.count >= 1)
     donor_of = _lane_by_rank(donor, n_lanes)
-    donor_lane = donor_of[jnp.arange(k, dtype=jnp.int32)]  # n_lanes if absent
-    valid = donor_lane < n_lanes
+    idx = jnp.arange(k, dtype=jnp.int32)
+    # k may exceed n_lanes (e.g. shed_k=8 against a 1-lane portfolio config);
+    # an OOB gather clamps to the last donor entry, so without the idx mask
+    # the same stack row would ship multiple times, all marked valid.
+    donor_lane = donor_of[jnp.clip(idx, 0, n_lanes - 1)]  # n_lanes if absent
+    valid = (idx < n_lanes) & (donor_lane < n_lanes)
     safe = jnp.clip(donor_lane, 0, n_lanes - 1)
     rows = state.stack[safe, state.base[safe] % s]
     rows = jnp.where(valid[:, None, None], rows, 0)
-    donor_sel = jnp.zeros(n_lanes, bool).at[donor_lane].set(valid, mode="drop")
+    # Route invalid entries OOB instead of .set(valid): duplicate clamped
+    # indices land on one lane and a scatter with mixed True/False values at
+    # the same index is order-undefined — a False could win and leave a
+    # shipped row on the donor stack (searched twice, re-shed forever).
+    donor_sel = (
+        jnp.zeros(n_lanes, bool)
+        .at[jnp.where(valid, donor_lane, n_lanes)]
+        .set(True, mode="drop")
+    )
     new_state = state._replace(
         base=jnp.where(donor_sel, (state.base + 1) % s, state.base),
         count=jnp.where(donor_sel, state.count - 1, state.count),

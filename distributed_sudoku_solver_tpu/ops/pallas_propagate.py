@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.propagate import RULE_TIERS
 
 try:  # pltpu imports on all jaxlib builds we target; guard for exotic ones
     from jax.experimental.pallas import tpu as pltpu
@@ -233,6 +234,84 @@ def _box_line_dir(
     return x & ~kill
 
 
+def naked_subsets_mosaic(
+    cand: jax.Array, geom: Geometry, row_ax: int, col_ax: int
+) -> jax.Array:
+    """Naked-subset eliminations from Mosaic-supported ops.
+
+    Same boolean algebra as ``ops.propagate.naked_subsets_sweep`` (the three
+    unit kills are computed from the same input and OR-combined, then applied
+    under the decided-cell guard), but the O(C^2) pairwise subset test is
+    expressed as C *probes*: one width-1 slice broadcast against the whole
+    block per probe, so no reshapes and no [C, C] intermediates — the same
+    slice-tree style as :func:`_box_line_dir`.
+    """
+    single = jax.lax.population_count(cand) == 1
+    kill = _subset_kill_line(cand, col_ax)  # row units: cells vary along cols
+    kill = kill | _subset_kill_line(cand, row_ax)  # column units
+    kill = kill | _subset_kill_box(cand, geom, row_ax, col_ax)
+    return jnp.where(single, cand, cand & ~kill)
+
+
+def _subset_kill_line(x: jax.Array, axis: int) -> jax.Array:
+    """Kill mask of the naked-subset rule for the line units along ``axis``."""
+    n = _axis_len(x, axis)
+    nz = x != jnp.uint32(0)
+    kill = jnp.zeros_like(x)
+    for i in range(n):
+        m = jnp.broadcast_to(_slice1(x, axis, i), x.shape)
+        sub = ((x & ~m) == 0) & nz
+        cnt = jnp.broadcast_to(
+            _group_reduce(sub.astype(jnp.int32), axis, n, operator.add), x.shape
+        )
+        k = jax.lax.population_count(m).astype(jnp.int32)
+        confined = (m != jnp.uint32(0)) & (cnt >= k)
+        hit = confined & (~sub | (cnt > k))
+        kill = kill | jnp.where(hit, m, jnp.uint32(0))
+    return kill
+
+
+def _axis_indicator(shape, axis: int, b: int):
+    """Bool masks [r]: index-along-axis % b == r.  Built from an in-graph
+    ``broadcasted_iota`` (not a host constant): ``pallas_call`` rejects
+    kernels that capture constants, and Mosaic supports >=2-D iota."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
+    return [(idx % b) == r for r in range(b)]
+
+
+def _subset_kill_box(
+    x: jax.Array, geom: Geometry, row_ax: int, col_ax: int
+) -> jax.Array:
+    """Kill mask of the naked-subset rule for the box units.
+
+    Box probes: for each in-box offset (r, c), select that cell of *every*
+    box at once (constant indicator masks — no strided slices, which Mosaic
+    rejects), box-OR-reduce + expand to broadcast the probe's mask over its
+    box, and run the same confined/overfull algebra as the line units.
+    """
+    bh, bw = geom.box_h, geom.box_w
+    nz = x != jnp.uint32(0)
+    kill = jnp.zeros_like(x)
+    rsel = _axis_indicator(x.shape, row_ax, bh)
+    csel = _axis_indicator(x.shape, col_ax, bw)
+
+    def box_broadcast(v, comb):
+        red = _group_reduce(_group_reduce(v, row_ax, bh, comb), col_ax, bw, comb)
+        return _expand(_expand(red, row_ax, bh), col_ax, bw)
+
+    for r in range(bh):
+        for c in range(bw):
+            sel = jnp.where(rsel[r] & csel[c], x, jnp.uint32(0))
+            m = box_broadcast(sel, _OR)
+            sub = ((x & ~m) == 0) & nz
+            cnt = box_broadcast(sub.astype(jnp.int32), operator.add)
+            k = jax.lax.population_count(m).astype(jnp.int32)
+            confined = (m != jnp.uint32(0)) & (cnt >= k)
+            hit = confined & (~sub | (cnt > k))
+            kill = kill | jnp.where(hit, m, jnp.uint32(0))
+    return kill
+
+
 def _fixpoint_boards_last(
     cand_t: jax.Array, geom: Geometry, max_sweeps: int, rules: str = "basic"
 ):
@@ -250,8 +329,10 @@ def _fixpoint_boards_last(
     def body(state):
         cur, _, sweeps = state
         nxt = sweep_mosaic(cur, geom, row_ax=0, col_ax=1)
-        if rules == "extended":
+        if rules in ("extended", "subsets"):
             nxt = box_line_mosaic(nxt, geom, row_ax=0, col_ax=1)
+        if rules == "subsets":
+            nxt = naked_subsets_mosaic(nxt, geom, row_ax=0, col_ax=1)
         return nxt, jnp.any(nxt != cur), sweeps + 1
 
     out, _, sweeps = jax.lax.while_loop(
@@ -292,7 +373,7 @@ def propagate_fixpoint_slices(
     large lane counts, where it beats the Pallas kernel by skipping the
     per-while-step ``pallas_call`` overhead.
     """
-    if rules not in ("basic", "extended"):
+    if rules not in RULE_TIERS:
         raise ValueError(f"unknown rules {rules!r}")
     out_t, sweeps = _fixpoint_boards_last(
         jnp.transpose(cand, (1, 2, 0)), geom, max_sweeps, rules
@@ -319,7 +400,7 @@ def propagate_fixpoint_pallas(
     """
     if cand.ndim != 3:
         raise ValueError(f"expected [B, n, n], got {cand.shape}")
-    if rules not in ("basic", "extended"):
+    if rules not in RULE_TIERS:
         raise ValueError(f"unknown rules {rules!r}")
     b, n, _ = cand.shape
     interp = _interpret_default() if interpret is None else interpret

@@ -29,6 +29,7 @@ from distributed_sudoku_solver_tpu.ops.bitmask import (
     is_single,
     once_twice_reduce,
     or_reduce,
+    popcount,
     to_boxes,
 )
 
@@ -102,6 +103,9 @@ def board_status(cand: jax.Array, geom: Geometry) -> BoardStatus:
     return BoardStatus(solved=solved, contradiction=contradiction)
 
 
+RULE_TIERS = ("basic", "extended", "subsets")
+
+
 def propagate(
     cand: jax.Array, geom: Geometry, max_sweeps: int = 64, rules: str = "basic"
 ) -> tuple[jax.Array, jax.Array]:
@@ -110,12 +114,15 @@ def propagate(
     ``rules='extended'`` adds the box-line reductions (:func:`box_line_sweep`)
     to each sweep — strictly stronger inference (fewer branch nodes, more
     boards closed without search) at a higher per-sweep cost.
+    ``rules='subsets'`` further adds naked-subset eliminations
+    (:func:`naked_subsets_sweep`) — every tier is a strict superset of the
+    one below, so masks only ever get tighter up the ladder.
 
     The loop condition is batch-global ("any board changed"), keeping the whole
     batch in one ``lax.while_loop`` — boards that stabilized early are cheap
     no-ops in later sweeps because every op is a fused elementwise pass.
     """
-    if rules not in ("basic", "extended"):
+    if rules not in RULE_TIERS:
         raise ValueError(f"unknown rules {rules!r}")
 
     def cond(state):
@@ -125,8 +132,10 @@ def propagate(
     def body(state):
         cur, _, sweeps = state
         nxt = propagate_sweep(cur, geom)
-        if rules == "extended":
+        if rules in ("extended", "subsets"):
             nxt = box_line_sweep(nxt, geom)
+        if rules == "subsets":
+            nxt = naked_subsets_sweep(nxt, geom)
         return nxt, jnp.any(nxt != cur), sweeps + 1
 
     cand, _, sweeps = jax.lax.while_loop(
@@ -201,6 +210,64 @@ def box_line_one_direction(
 
     kill = (point_other | claim_other)[..., None]  # broadcast over bw
     return (v & ~jnp.broadcast_to(kill, v.shape)).reshape(*lead, *x.shape[-2:])
+
+
+def naked_subsets_sweep(cand: jax.Array, geom: Geometry) -> jax.Array:
+    """Naked-subset eliminations in every unit, all subset sizes at once.
+
+    The rule, keyed on cell masks: for a cell with mask ``m`` (``k`` bits),
+    if exactly ``k`` nonzero cells of the unit are subsets of ``m``, those
+    ``k`` digits are pigeonhole-confined to those cells, so ``m``'s bits are
+    eliminated from every other cell of the unit.  One formulation covers
+    every naked pair (both pair cells carry the 2-bit union) plus any
+    triple/quad with a *witness* cell carrying the full union (``k=1``
+    degenerates to basic elimination; more than ``k`` subset cells is
+    itself a pigeonhole contradiction, which the sweep *exposes* by
+    clearing the subset cells too instead of leaving it latent).
+    Witness-free subsets — e.g. the triple {4,5},{5,6},{4,6}, whose union
+    appears in no single cell — are deliberately out of scope: detecting
+    them needs probes over unions of cell pairs (O(C^2) probes instead of
+    C), and the pair case that dominates in practice never needs it.
+
+    This is the third inference tier (``rules='subsets'``), aimed at deep
+    search on giant boards where basic+box-line propagation is nearly blind
+    (BENCHMARKS.md, sparse 25x25).  The reference has no counterpart at any
+    tier — its only rule is the per-guess membership scan
+    (``/root/reference/utils.py:27-55``).
+
+    Cost is O(C^2) pairwise subset tests per unit (C = cells per unit): the
+    probe loop materializes as one broadcast compare + sum per unit view,
+    which XLA fuses; the Mosaic twin (``ops/pallas_propagate.py``) runs the
+    same algebra as C width-1 slices.
+    """
+    single = is_single(cand)
+    kill = jnp.zeros_like(cand)
+    for view, undo in _unit_views(cand, geom):
+        kill = kill | undo(_naked_subset_kill(view))
+    return jnp.where(single, cand, cand & ~kill)
+
+
+def _naked_subset_kill(view: jax.Array) -> jax.Array:
+    """Per-cell kill mask of the naked-subset rule on unit view [..., U, C].
+
+    For probe cell i and tested cell j of the same unit:
+    ``sub[i, j] = x[j] != 0 and x[j] subset-of x[i]``;
+    ``cnt[i] = sum_j sub[i, j]``; probe i is *confined* when
+    ``cnt[i] >= popcount(x[i])``; its mask then kills in every cell outside
+    the subset — and everywhere (exposing the contradiction) when strictly
+    overfull.  Shared verbatim by the board-sharded twin
+    (``parallel/board_sharded.py``), whose units arrive here chip-local
+    (rows, boxes) or gathered (columns).
+    """
+    m = view[..., :, None]  # probe i's mask, broadcast over tested j
+    x = view[..., None, :]
+    sub = ((x & ~m) == 0) & (x != 0)
+    cnt = jnp.sum(sub.astype(jnp.int32), axis=-1)  # [..., U, C_i]
+    k = popcount(view).astype(jnp.int32)
+    confined = (view != 0) & (cnt >= k)
+    over = (cnt > k)[..., None]
+    hit = confined[..., None] & (~sub | over)
+    return or_reduce(jnp.where(hit, jnp.broadcast_to(m, hit.shape), jnp.uint32(0)), -2)
 
 
 def _or_others(x: jax.Array, axis: int) -> jax.Array:

@@ -145,7 +145,8 @@ class BandedSudoku:
     bands_per_chip: int
     branch_rule: str = "minrem"
     max_sweeps: int = 64
-    rules: str = "basic"  # 'basic' | 'extended' (+ banded box-line reductions)
+    rules: str = "basic"  # 'basic' | 'extended' (+ banded box-line
+    #   reductions) | 'subsets' (+ banded naked-subset eliminations)
 
     @property
     def rows_local(self) -> int:
@@ -227,9 +228,45 @@ class BandedSudoku:
         c_unique = (c_once & ~c_twice)[..., None, :]
         forced = forced | (cand & c_unique)
         cand = jnp.where(~single & (forced != 0), forced, cand)
-        if self.rules == "extended":
+        if self.rules in ("extended", "subsets"):
             cand = self._box_line(cand)
+        if self.rules == "subsets":
+            cand = self._naked_subsets(cand)
         return cand
+
+    def _naked_subsets(self, cand: jax.Array) -> jax.Array:
+        """Banded naked-subset eliminations (``rules='subsets'`` twin).
+
+        Row and box units are chip-local (a shard is a stack of complete
+        bands) and reuse ``ops/propagate._naked_subset_kill`` verbatim.
+        Column units need every cell of the column: unlike the basic sweep's
+        once/twice aggregates, the subset test is *probe-dependent* (count
+        of cells contained in each probe's mask), which no fixed-size
+        associative reduce expresses — so the columns ride one
+        ``all_gather`` over the band axis (XLA lowers it as the same ICI
+        ring the ppermute reductions use) and the unsharded kill math runs
+        on the gathered view.  Pad rows hold the empty mask 0, which the
+        rule ignores on both sides (zero probes are never confined, zero
+        cells never counted), so the gathered column is bit-equivalent to
+        the unsharded one and the banded fixpoint stays bit-exact
+        (``tests/test_subsets.py::test_subsets_banded_bit_exact``).
+        """
+        from distributed_sudoku_solver_tpu.ops.propagate import _naked_subset_kill
+
+        single = is_single(cand)
+        kill = _naked_subset_kill(cand)  # rows: [L, rows_local(units), n]
+        kill = kill | self._from_boxes(_naked_subset_kill(self._to_boxes(cand)))
+        gathered = jax.lax.all_gather(
+            cand, self.axis, axis=-2, tiled=True
+        )  # [L, rows_padded, n]
+        col_kill_full = jnp.swapaxes(
+            _naked_subset_kill(jnp.swapaxes(gathered, -1, -2)), -1, -2
+        )
+        chip = jax.lax.axis_index(self.axis)
+        kill = kill | jax.lax.dynamic_slice_in_dim(
+            col_kill_full, chip * self.rows_local, self.rows_local, axis=-2
+        )
+        return jnp.where(single, cand, cand & ~kill)
 
     def _box_line(self, cand: jax.Array) -> jax.Array:
         """Banded pointing/claiming (``ops/propagate.box_line_sweep`` twin).
@@ -404,7 +441,9 @@ class BandedSudoku:
 def _banded_problem(
     geom: Geometry, config: SolverConfig, n_dev: int, axis: str
 ) -> BandedSudoku:
-    if config.rules not in ("basic", "extended"):
+    from distributed_sudoku_solver_tpu.ops.propagate import RULE_TIERS
+
+    if config.rules not in RULE_TIERS:
         raise ValueError(f"unknown rules {config.rules!r}")
     if config.branch not in ("minrem", "first"):
         # The banded pmin-key branch implements these two orders only; fail

@@ -20,7 +20,7 @@ Superset endpoints (absent from the reference):
   ``ops/bulk`` one-dispatch pipeline.  Body either
   ``{"boards": [[[...]], ...]}`` (nested int grids) or
   ``{"lines": ["53..7....", ...], "size": 9}`` (puzzle strings, base-36
-  digits); optional ``"rules"`` ('basic'|'extended') and ``"chunk"``.
+  digits); optional ``"rules"`` ('basic'|'extended'|'subsets') and ``"chunk"``.
   Response mirrors the input form: ``solutions`` as grids or as strings
   (zeros line = unsolved), plus per-board ``solved``/``unsat`` and counts.
   Chunks run on the engine's device-owner thread between flight chunks
@@ -206,7 +206,12 @@ class _Handler(BaseHTTPRequestHandler):
         ]
         for i, job in pending:
             if not job.wait(max(1.0, deadline - time.time())):
-                engine.cancel(job.uuid)
+                # All stragglers were submitted up front: cancel every one
+                # still pending, not just the first timed-out job, or the
+                # rest keep burning the engine with no waiter.
+                for _, other in pending:
+                    if not other.done.is_set():
+                        engine.cancel(other.uuid)
                 return self._send(
                     504, {"error": "straggler solve timed out", "done": int(i)}
                 )

@@ -242,6 +242,49 @@ def test_midjob_offload_to_idle_peer():
                 n.engine.stop(timeout=1)
 
 
+def test_part_recovery_after_peer_death():
+    """ADVICE r2 #1: a SUBTASK part whose executing peer dies is re-entered
+    locally from the rows retained at shed time, so the root job still
+    finalizes — including the exhaustion path, which requires every part's
+    subspace to be accounted for."""
+    ccfg = ClusterConfig(
+        heartbeat_s=0.25,
+        fail_factor=8.0,
+        io_timeout_s=2.0,
+        needwork=True,
+        shed_k=4,
+        progress_interval_s=0.0,
+    )
+    board = _deep_unsat_board()
+    a = _flight_node(cluster_cfg=ccfg)
+    b = _flight_node(anchor=a.addr, cluster_cfg=ccfg)
+    try:
+        assert wait_for(lambda: len(a.network) == 2 and len(b.network) == 2, timeout=30)
+        _warm(a.engine)
+        _warm(b.engine)
+        # a is slow enough that the search outlives b's death + detection
+        # (~2 s); b is so slow its stolen part cannot finish before then, so
+        # the part is genuinely lost and must be recovered from the retained
+        # rows, not completed by b's lingering engine thread.
+        a.engine.handicap_s = 0.05
+        b.engine.handicap_s = 1.0
+        job = a._submit_local(board)
+        assert wait_for(
+            lambda: a.subtasks_sent >= 1 and b.subtasks_run >= 1, timeout=60
+        ), "idle peer never stole a part"
+        assert not job.done.is_set()
+        b.kill()
+        assert job.wait(120), "job never finalized after part-executing peer died"
+        # The recovered part ran here (subtasks_run counts local re-entry)
+        # and its exhaustion composed into a complete unsat proof.
+        assert a.subtasks_run >= 1, "lost part was not re-entered locally"
+        assert job.unsat and not job.solved
+    finally:
+        for n in (a, b):
+            n.kill()
+            n.engine.stop(timeout=1)
+
+
 def test_resume_from_progress_snapshot():
     """VERDICT r1 #4: a worker streams PROGRESS snapshots; when it dies, the
     origin resumes mid-subtree and provably skips already-searched work

@@ -80,6 +80,26 @@ def test_shed_rows_partitions_search_space():
     assert bool(loser.unsat[0])
 
 
+def test_shed_rows_k_exceeding_lanes_no_duplicates():
+    """ADVICE r2 #2 + review: k > n_lanes must not ship the same stack row
+    twice (clamped OOB gathers repeat the last donor), and the one genuinely
+    shipped row must actually leave the donor's stack (the mixed-value
+    scatter at a duplicated index is order-undefined)."""
+    cfg = SolverConfig(min_lanes=1, lanes=1, stack_slots=16, branch="first")
+    state = start_frontier(jnp.asarray(np.asarray(HARD_9[0])[None]), GEOM, cfg)
+    state = advance_frontier(state, jnp.int32(4), GEOM, cfg)
+    count_before = int(np.asarray(state.count)[0])
+    assert count_before >= 1
+    new_state, rows, valid = jax.jit(shed_rows, static_argnames=("k",))(
+        state, jnp.int32(0), 8
+    )
+    valid = np.asarray(valid)
+    assert valid.sum() == 1, "one donor lane can donate exactly one row"
+    assert int(np.asarray(new_state.count)[0]) == count_before - 1, (
+        "the shipped row must be removed from the donor stack"
+    )
+
+
 def test_purge_jobs_frees_lanes_and_never_claims_unsat():
     state = _mid_state(HARD_9[0])
     assert bool(np.asarray(frontier_live(state)).any())

@@ -1,0 +1,191 @@
+"""The 'subsets' inference tier (VERDICT r2 #2): naked-subset eliminations.
+
+The rule, keyed on cell masks: if inside a unit exactly ``popcount(m)``
+nonzero cells are subsets of a cell's mask ``m``, those digits are confined
+to those cells, so ``m``'s bits die everywhere else in the unit.  One rule
+covers naked pairs, triples, quads... (any k); k=1 degenerates to basic
+elimination.  The reference has no inference at all (its only rule is the
+per-guess ``is_valid`` scan, ``/root/reference/utils.py:27-55``) — this
+tier exists for deep search on giant boards, where BENCHMARKS.md's sparse
+25x25 row showed near-blind branching.
+
+Soundness oracle: a rule application may never delete the true digit of a
+solvable board's solution.  Tier laddering: masks under 'subsets' are
+always a subset of masks under 'extended' (strictly stronger inference).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid, decode_grid
+from distributed_sudoku_solver_tpu.ops.propagate import (
+    board_status,
+    naked_subsets_sweep,
+    propagate,
+)
+from distributed_sudoku_solver_tpu.utils.oracle import solve_oracle
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+SUDOKU_9 = geometry_for_size(9)
+
+
+def _mask(*digits):
+    m = 0
+    for d in digits:
+        m |= 1 << (d - 1)
+    return m
+
+
+def test_naked_pair_eliminates_in_row():
+    """Textbook naked pair: cells 0,1 both {1,2} -> 1,2 die in the rest of
+    the row and nowhere else."""
+    full = SUDOKU_9.full_mask
+    cand = np.full((1, 9, 9), full, np.uint32)
+    cand[0, 0, 0] = _mask(1, 2)
+    cand[0, 0, 1] = _mask(1, 2)
+    out = np.asarray(naked_subsets_sweep(jnp.asarray(cand), SUDOKU_9))
+    assert out[0, 0, 0] == _mask(1, 2)
+    assert out[0, 0, 1] == _mask(1, 2)
+    for c in range(2, 9):
+        assert out[0, 0, c] == (full & ~_mask(1, 2)), f"col {c}"
+    # The two pair cells also share box 0, so the box unit clears {1,2} from
+    # the box's other cells; everything outside row 0 and box 0 is untouched
+    # (in the columns the pair counts 1 subset cell < k=2 — nothing fires).
+    for r in range(1, 3):
+        for c in range(3):
+            assert out[0, r, c] == (full & ~_mask(1, 2)), f"box cell {r},{c}"
+    assert (out[0, 1:3, 3:] == full).all()
+    assert (out[0, 3:, :] == full).all()
+
+
+def test_naked_triple_eliminates_in_box():
+    """Three cells of one box jointly holding {4,5,6} — with a witness cell
+    carrying the full union — kill those digits in the box's other cells.
+
+    (The rule is keyed on a witness cell's mask: a witness-free triple like
+    {4,5},{5,6},{4,6} is deliberately out of scope — see
+    ``naked_subsets_sweep``'s docstring.)"""
+    full = SUDOKU_9.full_mask
+    cand = np.full((1, 9, 9), full, np.uint32)
+    cand[0, 0, 0] = _mask(4, 5, 6)  # the witness
+    cand[0, 1, 1] = _mask(5, 6)
+    cand[0, 2, 2] = _mask(4, 6)
+    out = np.asarray(naked_subsets_sweep(jnp.asarray(cand), SUDOKU_9))
+    tri = _mask(4, 5, 6)
+    for r in range(3):
+        for c in range(3):
+            if (r, c) in ((0, 0), (1, 1), (2, 2)):
+                continue
+            assert out[0, r, c] & tri == 0, f"cell {r},{c} kept a triple digit"
+    # Triple cells themselves are untouched.
+    assert out[0, 0, 0] == _mask(4, 5, 6)
+    assert out[0, 1, 1] == _mask(5, 6)
+    assert out[0, 2, 2] == _mask(4, 6)
+
+
+def test_overfull_subset_is_a_contradiction():
+    """Three cells all {1,2} in a row: pigeonhole-unsat; the sweep exposes
+    it (empty cell) instead of leaving it latent."""
+    full = SUDOKU_9.full_mask
+    cand = np.full((1, 9, 9), full, np.uint32)
+    for c in range(3):
+        cand[0, 0, c] = _mask(1, 2)
+    out = naked_subsets_sweep(jnp.asarray(cand), SUDOKU_9)
+    st = board_status(out, SUDOKU_9)
+    assert bool(st.contradiction[0])
+
+
+@pytest.mark.parametrize("size", [9, 12, 16])
+def test_subsets_sound_and_stronger(size):
+    """On solvable boards: 'subsets' masks are a subset of 'extended' masks
+    (strictly stronger inference) and never delete the true digit.  12x12
+    exercises rectangular (3x4) boxes."""
+    from distributed_sudoku_solver_tpu.models.geometry import Geometry
+
+    geom = Geometry(3, 4) if size == 12 else geometry_for_size(size)
+    if size == 9:
+        boards = [np.asarray(EASY_9)] + [np.asarray(b) for b in HARD_9[:3]]
+    else:
+        from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
+
+        boards = [
+            make_puzzle(geom, seed=7 + i, n_clues=int(geom.n * geom.n * 0.55))
+            for i in range(3)
+        ]
+    for g in boards:
+        sol = solve_oracle(g, geom)
+        assert sol is not None
+        cand = encode_grid(jnp.asarray(g[None]), geom)
+        ext, _ = propagate(cand, geom, rules="extended")
+        sub, _ = propagate(cand, geom, rules="subsets")
+        e, s = np.asarray(ext[0]), np.asarray(sub[0])
+        assert ((s & ~e) == 0).all(), "subsets produced a bit extended lacked"
+        for r in range(geom.n):
+            for c in range(geom.n):
+                assert s[r, c] & (1 << (sol[r, c] - 1)), (
+                    f"subsets removed the true digit at {r},{c}"
+                )
+
+
+def test_subsets_end_to_end_solve():
+    """Full frontier search under the subsets tier still reproduces the
+    oracle's unique solutions."""
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+    cfg = SolverConfig(min_lanes=16, stack_slots=32, rules="subsets")
+    boards = np.stack([np.asarray(b) for b in HARD_9[:4]])
+    res = solve_batch(jnp.asarray(boards), SUDOKU_9, cfg)
+    assert bool(res.solved.all())
+    for i in range(len(boards)):
+        assert (np.asarray(res.solution[i]) == solve_oracle(boards[i], SUDOKU_9)).all()
+
+
+@pytest.mark.parametrize("backend", ["pallas", "slices"])
+def test_subsets_fixpoint_parity_all_backends(backend):
+    """The Mosaic slice-algebra twin reaches the identical fixpoint on the
+    subsets tier — random boards plus corpus boards, like the 'extended'
+    parity tests in test_pallas.py."""
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+        propagate_fixpoint_pallas,
+        propagate_fixpoint_slices,
+    )
+
+    rng = np.random.default_rng(11)
+    rand = rng.integers(1, SUDOKU_9.full_mask + 1, (32, 9, 9)).astype(np.uint32)
+    corpus = encode_grid(
+        jnp.asarray(np.stack([np.asarray(b) for b in HARD_9[:4]])), SUDOKU_9
+    )
+    for cand in (jnp.asarray(rand), corpus):
+        ref, _ = propagate(cand, SUDOKU_9, rules="subsets")
+        if backend == "pallas":
+            got, _ = propagate_fixpoint_pallas(cand, SUDOKU_9, tile=8, rules="subsets")
+        else:
+            got, _ = propagate_fixpoint_slices(cand, SUDOKU_9, rules="subsets")
+        assert (np.asarray(got) == np.asarray(ref)).all()
+
+
+def test_subsets_banded_bit_exact():
+    """The board-sharded twin (rows/boxes chip-local, columns on a gathered
+    view) matches the single-device subsets tier bit-for-bit — same
+    solutions AND same node counts, i.e. the identical search tree."""
+    import jax
+
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+    from distributed_sudoku_solver_tpu.parallel.board_sharded import (
+        make_band_mesh,
+        solve_batch_banded,
+    )
+
+    mesh = make_band_mesh(jax.devices()[:3])
+    cfg = SolverConfig(min_lanes=8, stack_slots=32, max_steps=4096, rules="subsets")
+    boards = jnp.asarray(np.stack([np.asarray(b) for b in HARD_9[:3]]))
+    ref = solve_batch(boards, SUDOKU_9, cfg)
+    res = solve_batch_banded(boards, SUDOKU_9, cfg, mesh=mesh)
+    assert (np.asarray(res.solved) == np.asarray(ref.solved)).all()
+    assert (np.asarray(res.solution) == np.asarray(ref.solution)).all()
+    assert (np.asarray(res.nodes) == np.asarray(ref.nodes)).all()
