@@ -1,0 +1,96 @@
+"""Inference-tier matrix on deep-search 25x25 corpora (VERDICT r2 #2).
+
+The sparse (45%-clue) 25x25 corpus is the workload where round 2 measured
+~1.1 boards/s — propagation stopped at box-line, so giant-board deep search
+was nearly blind branching.  This benchmark pits the rule tiers against each
+other on that exact protocol (64 boards, `puzzle_batch` seed 5,
+`stack_slots=64`, one-dispatch bulk path, best-of-N warm, tiers interleaved
+within each repeat so tunnel-throughput drift hits all tiers equally).
+
+Emits one JSON line per tier: boards/s (best), searched count, total nodes,
+and per-repeat wall times.  Run on the real chip:
+
+    python benchmarks/bench_tier25.py --clues 0.45 --count 64 --repeat 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # runnable from any cwd without installing
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clues", type=float, default=0.45)
+    ap.add_argument("--count", type=int, default=64)
+    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--size", type=int, default=25)
+    ap.add_argument(
+        "--tiers", type=str, default="basic,extended,subsets",
+        help="comma-separated rule tiers to race",
+    )
+    ap.add_argument("--stack-slots", type=int, default=64)
+    ap.add_argument(
+        "--rungs", type=str, default=None,
+        help="escalation ladder as 'jobs,lanes,slots[,steps];...' "
+        "(e.g. the round-2 ladder '2048,4,64;64,64,256' used for the "
+        "BENCHMARKS.md tier table); default: geometry-resolved",
+    )
+    args = ap.parse_args()
+    rungs = (
+        tuple(tuple(int(v) for v in r.split(",")) for r in args.rungs.split(";"))
+        if args.rungs
+        else None
+    )
+
+    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+    from distributed_sudoku_solver_tpu.utils.puzzles import puzzle_batch
+
+    geom = geometry_for_size(args.size)
+    grids = puzzle_batch(
+        geom, args.count, seed=5, n_clues=int(geom.n**2 * args.clues), unique=False
+    ).astype(np.int32)
+    tiers = args.tiers.split(",")
+    cfgs = {
+        t: BulkConfig(
+            chunk=args.count, stack_slots=args.stack_slots, rules=t, rungs=rungs
+        )
+        for t in tiers
+    }
+
+    # Warm every tier's compile cache before any timed repeat.
+    results = {t: solve_bulk(grids, geom, cfgs[t]) for t in tiers}
+    walls: dict[str, list] = {t: [] for t in tiers}
+    for _ in range(args.repeat):
+        for t in tiers:  # interleaved: drift hits every tier equally
+            t0 = time.perf_counter()
+            results[t] = solve_bulk(grids, geom, cfgs[t])
+            walls[t].append(round(time.perf_counter() - t0, 3))
+
+    for t in tiers:
+        res, best = results[t], min(walls[t])
+        print(
+            json.dumps(
+                {
+                    "metric": f"tier25_{int(args.clues * 100)}pct_{t}",
+                    "value": round(args.count / best, 2),
+                    "unit": "boards/s",
+                    "solved": int(res.solved.sum()),
+                    "searched": res.searched,
+                    "walls_s": walls[t],
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

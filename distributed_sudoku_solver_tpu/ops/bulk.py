@@ -62,9 +62,14 @@ class BulkConfig:
     rules: str = "extended"  # box-line reductions close ~26% more boards
     #   without search on hard-mix corpora; measured faster end-to-end
     # Escalation rungs for unresolved boards: (max jobs/chunk, lanes per job,
-    # stack slots).  Wider-than-jobs lanes give straggler jobs an OR-parallel
-    # gang of thief lanes; deep stacks make overflow impossible in practice.
-    rungs: tuple = ((2048, 4, 64), (64, 64, 256))
+    # stack slots[, step budget]).  Wider-than-jobs lanes give straggler jobs
+    # an OR-parallel gang of thief lanes; the optional 4th element bounds how
+    # long a rung grinds before handing survivors to the next one (default:
+    # max_steps).  None = geometry default (:func:`default_rungs`): the 9x9
+    # ladder is wrong for giant boards, where the narrow first rung burned
+    # its entire 100k-step budget at 4-lane parallelism — measured 1.9 vs
+    # 5.6 boards/s on the 45%-clue 25x25 corpus (BENCHMARKS.md).
+    rungs: Optional[tuple] = None
     inflight: int = 3  # dispatched-ahead chunks before draining the oldest
     # Dispatch-time bounds.  A single while_loop dispatch that runs for
     # minutes trips device/RPC watchdogs and kills the worker (observed on a
@@ -84,6 +89,31 @@ class BulkConfig:
 
         if self.rules not in RULE_TIERS:
             raise ValueError(f"unknown rules {self.rules!r}")
+
+
+def default_rungs(geom: Geometry) -> tuple:
+    """Geometry-resolved escalation ladder (``BulkConfig.rungs=None``).
+
+    Small boards (9x9 hard-mix): a narrow 4-lane rung first — stragglers
+    are plentiful and shallow, and the wide-gang rung only sees the rare
+    deep survivor (the round-2 tuned ladder, ~101k boards/s device-only).
+    The narrow rung gets a bounded step budget so a genuinely deep board
+    stops grinding at 4-lane parallelism and escalates.
+
+    Giant boards (16x16 up): stragglers are *deep*, so they go straight to
+    128-lane OR-parallel gangs with a 32-slot stack (the widest shape that
+    fits ``rung_stack_mb`` at 25x25 without narrowing).  Measured on the
+    45%-clue 25x25 corpus: 1.90 -> 5.55 boards/s (BENCHMARKS.md,
+    "Inference tiers and rung shapes on deep search").  A deep-stack
+    completeness rung follows: a lane whose DFS overflows 32 deferred
+    siblings drops a subtree and downgrades its verdict to unknown
+    (``ops/frontier.py``), so such boards retry at 256 slots — narrower
+    (16 lanes, the ``rung_stack_mb`` ceiling at 25x25) but overflow-proof
+    in practice, preserving the old ladder's completeness guarantee.
+    """
+    if geom.n >= 16:
+        return ((64, 128, 32), (64, 16, 256))
+    return ((2048, 4, 64, 16_384), (64, 64, 256))
 
 
 @dataclasses.dataclass
@@ -252,9 +282,14 @@ def solve_bulk(
         )
 
     remaining = np.flatnonzero(~solved & ~unsat)
-    for max_jobs, lanes_per_job, slots in config.rungs:
+    rungs = default_rungs(geom) if config.rungs is None else config.rungs
+    for rung in rungs:
         if len(remaining) == 0:
             break
+        max_jobs, lanes_per_job, slots = rung[:3]
+        rung_steps = (
+            min(int(rung[3]), config.max_steps) if len(rung) > 3 else config.max_steps
+        )
         # Round the chunk up to a power of two (>= 64) so each rung compiles
         # O(log) distinct shapes across calls, not one per survivor count.
         jobs_per_chunk = min(
@@ -280,7 +315,7 @@ def solve_bulk(
         scfg = SolverConfig(
             lanes=-(-lanes // n_dev) * n_dev,  # round up: lanes >= jobs always
             stack_slots=slots,
-            max_steps=config.max_steps,
+            max_steps=rung_steps,
             max_sweeps=config.max_sweeps,
             propagator=prop,
             rules=config.rules,
