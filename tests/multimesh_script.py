@@ -1,0 +1,141 @@
+"""Helper process for tests/test_multihost.py::test_cross_process_mesh.
+
+One controller process per mesh *half* (VERDICT r2 #3): the parent starts
+two of these, each with 4 virtual CPU devices
+(``--xla_force_host_platform_device_count=4``), joined into ONE
+``jax.distributed`` runtime — so ``jax.devices()`` is a global 8-device
+list spanning both OS processes.  Both controllers issue the identical
+``solve_batch_sharded`` program over a global 8-device mesh; the
+``shard_map`` body's collectives (``psum``/``pmin``/``ppermute`` ring
+steals, ``parallel/sharded.py``) therefore cross the process boundary —
+the multi-host data path the reference ran over sockets
+(``/root/reference/DHT_Node.py:623-665``), here as XLA collectives the way
+they would ride DCN on real multi-host TPU.
+
+Each role dumps the full replicated result; the parent (which owns a
+single-process 8-device mesh) asserts bit-identity against its own run of
+the same program — the only difference between the two executions is the
+process boundary in the middle of the mesh.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def spawn_mesh_pair(workdir, devices_per_proc: int = 4, timeout: float = 240):
+    """Launch the two mesh-half controllers; return [(returncode, output)].
+
+    The one launch recipe shared by ``tests/test_multihost.py`` and
+    ``__graft_entry__.dryrun_multichip`` (so env-scrub rules can't drift):
+    scrub the TPU-tunnel trigger, force the CPU backend with
+    ``devices_per_proc`` virtual devices, and prepend the repo to
+    PYTHONPATH.  Every exit path reaps both children: a child that hangs
+    is killed and reported via its returncode (never an uncaught
+    TimeoutExpired), and a child that dies early can't orphan its sibling
+    in a collective wait.
+    """
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={max(1, devices_per_proc)}"
+    )
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                str(role),
+                str(coord),
+                str(workdir),
+            ],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for role in (0, 1)
+    ]
+    try:
+        results = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            results.append((p.returncode, out.decode(errors="replace")))
+        return results
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def main() -> None:
+    role = int(sys.argv[1])
+    coord_port = int(sys.argv[2])
+    workdir = sys.argv[3]
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{coord_port}",
+        num_processes=2,
+        process_id=role,
+    )
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.parallel.mesh import make_mesh
+    from distributed_sudoku_solver_tpu.parallel.sharded import solve_batch_sharded
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    out = {
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+    }
+
+    grids = np.stack([np.asarray(b) for b in HARD_9[:4]]).astype(np.int32)
+    cfg = SolverConfig(min_lanes=32, stack_slots=32, max_steps=4096)
+
+    mesh = make_mesh(jax.devices())  # 8 devices spanning both processes
+    out["mesh_spans_processes"] = (
+        len({d.process_index for d in mesh.devices.flat}) == 2
+    )
+
+    # Replicated global input: every process supplies the same host array.
+    sharding = NamedSharding(mesh, P())
+    garr = jax.make_array_from_callback(
+        grids.shape, sharding, lambda idx: grids[idx]
+    )
+    res = solve_batch_sharded(garr, SUDOKU_9, cfg, mesh=mesh)
+
+    # Out-specs are replicated, so every process holds the full result.
+    out["solved"] = np.asarray(res.solved).tolist()
+    out["solution"] = np.asarray(res.solution).tolist()
+    out["nodes"] = np.asarray(res.nodes).tolist()
+    out["steals"] = int(np.asarray(res.steals))
+    out["steps"] = int(np.asarray(res.steps))
+
+    with open(os.path.join(workdir, f"mesh_result{role}.json"), "w") as f:
+        json.dump(out, f)
+    jax.distributed.shutdown()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,62 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_cross_process_mesh(tmp_path):
+    """VERDICT r2 #3: ONE device mesh spanning two OS processes.
+
+    Two controller processes x 4 virtual CPU devices each join one
+    ``jax.distributed`` runtime and run ``solve_batch_sharded`` over the
+    global 8-device mesh — ``shard_map`` collectives (psum/pmin/ppermute
+    ring steals) cross the process boundary.  The result must be
+    bit-identical (solutions AND node counts AND step count) to this
+    parent process's own single-process 8-device run of the same program:
+    the process boundary must be invisible to the math.
+    """
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from multimesh_script import spawn_mesh_pair
+
+    pair = spawn_mesh_pair(tmp_path, devices_per_proc=4)
+    debug = "".join(
+        f"--- role{i} (rc={rc}) ---\n{out[-3000:]}\n"
+        for i, (rc, out) in enumerate(pair)
+    )
+    assert all(rc == 0 for rc, _ in pair), debug
+
+    results = []
+    for role in (0, 1):
+        with open(tmp_path / f"mesh_result{role}.json") as f:
+            results.append(json.load(f))
+    for r in results:
+        assert r["process_count"] == 2, debug
+        assert r["global_devices"] == 8 and r["local_devices"] == 4
+        assert r["mesh_spans_processes"], "mesh did not span both processes"
+
+    # Single-process 8-device reference (this pytest process's mesh).
+    import jax
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.parallel.mesh import make_mesh
+    from distributed_sudoku_solver_tpu.parallel.sharded import (
+        solve_batch_sharded,
+    )
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    grids = np.stack([np.asarray(b) for b in HARD_9[:4]]).astype(np.int32)
+    cfg = SolverConfig(min_lanes=32, stack_slots=32, max_steps=4096)
+    ref = solve_batch_sharded(grids, SUDOKU_9, cfg, mesh=make_mesh(jax.devices()))
+
+    for r in results:
+        assert r["solved"] == np.asarray(ref.solved).tolist()
+        assert r["solution"] == np.asarray(ref.solution).tolist()
+        assert r["nodes"] == np.asarray(ref.nodes).tolist()
+        assert r["steps"] == int(np.asarray(ref.steps))
+    # Both controllers saw the identical replicated result.
+    assert results[0] == results[1]
+
+
 def test_two_process_cluster_with_jax_distributed(tmp_path):
     coord, p0, p1 = _free_port(), _free_port(), _free_port()
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
