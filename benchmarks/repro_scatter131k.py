@@ -1,0 +1,135 @@
+"""Minimal repro: XLA:TPU scatter-fusion CHECK at >65,536 frontier lanes.
+
+BENCHMARKS.md ("XLA:TPU note") caps chunk defaults at 65,536 lanes because
+131,072-lane compiles crash the backend inside ``scatter_emitter.cc``.
+This script pins the failure with progressively smaller graphs:
+
+  stage full   — the whole ``frontier_step`` (the production shape)
+  stage push   — ONLY the stack push scatter ``stack.at[lane, slot].set(row)``
+  stage onehot — the scatter-free reformulation of the same update (masked
+                 full-stack where), to test whether avoiding scatter unlocks
+                 the shape
+
+Usage (one TPU process at a time; compile-only, no dispatch):
+
+    python benchmarks/repro_scatter131k.py --lanes 131072 --stage full
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=131072)
+    ap.add_argument("--slots", type=int, default=12)
+    ap.add_argument(
+        "--stage",
+        choices=("full", "push", "onehot", "loop", "wire", "solve_wire", "solve", "init"),
+        default="full",
+    )
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.frontier import (
+        SolverConfig,
+        frontier_step,
+        init_frontier,
+    )
+    from distributed_sudoku_solver_tpu.ops.solve import sudoku_csp
+
+    L, S = args.lanes, args.slots
+    print(f"stage={args.stage} lanes={L} slots={S} backend={jax.default_backend()}")
+
+    if args.stage in ("full", "loop"):
+        from distributed_sudoku_solver_tpu.ops.frontier import run_frontier
+
+        cfg = SolverConfig(lanes=L, stack_slots=S, propagator="slices")
+        problem = sudoku_csp(SUDOKU_9, cfg)
+        state = init_frontier(jnp.zeros((L, 9, 9), jnp.uint32), cfg)
+        if args.stage == "full":
+            fn = jax.jit(lambda st: frontier_step(st, problem, cfg))
+        else:  # the whole while_loop, as the bulk first pass runs it
+            fn = jax.jit(lambda st: run_frontier(st, problem, cfg))
+        lowered = fn.lower(state)
+    elif args.stage == "solve":
+        from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+        cfg = SolverConfig(
+            lanes=L, stack_slots=S, propagator="slices", max_steps=4096
+        )
+        grids = jnp.zeros((L, 9, 9), jnp.int32)
+        lowered = jax.jit(
+            lambda g: solve_batch(g, SUDOKU_9, cfg), static_argnums=()
+        ).lower(grids)
+    elif args.stage == "init":
+        cfg = SolverConfig(lanes=L, stack_slots=S)
+        cand = jnp.zeros((L, 9, 9), jnp.uint32)
+        lowered = jax.jit(lambda c: init_frontier(c, cfg)).lower(cand)
+    elif args.stage == "wire":
+        from distributed_sudoku_solver_tpu.ops import wire
+
+        packed = jnp.zeros(
+            wire.pack_grids_host(np.zeros((L, 9, 9), np.int32), SUDOKU_9).shape,
+            jnp.uint8,
+        )
+        fn = jax.jit(lambda p: wire.unpack_grids_device(p, SUDOKU_9))
+        lowered = fn.lower(packed)
+    elif args.stage == "solve_wire":
+        from distributed_sudoku_solver_tpu.ops import wire
+        from distributed_sudoku_solver_tpu.ops.solve import solve_batch_wire
+
+        cfg = SolverConfig(
+            lanes=L, stack_slots=S, propagator="slices", max_steps=4096
+        )
+        packed = jnp.zeros(
+            wire.pack_grids_host(np.zeros((L, 9, 9), np.int32), SUDOKU_9).shape,
+            jnp.uint8,
+        )
+        lowered = solve_batch_wire.lower(packed, SUDOKU_9, cfg)
+    else:
+        stack = jnp.zeros((L, S, 9, 9), jnp.uint32)
+        rest = jnp.zeros((L, 9, 9), jnp.uint32)
+        can_push = jnp.zeros(L, bool)
+        slot = jnp.zeros(L, jnp.int32)
+
+        if args.stage == "push":
+
+            def push(stack, rest, can_push, slot):
+                lane_idx = jnp.arange(L, dtype=jnp.int32)
+                return stack.at[
+                    jnp.where(can_push, lane_idx, L), jnp.clip(slot, 0, S - 1)
+                ].set(rest, mode="drop")
+
+        else:  # onehot: scatter-free masked write of the same update
+
+            def push(stack, rest, can_push, slot):
+                sel = (
+                    jnp.arange(S, dtype=jnp.int32)[None, :] == slot[:, None]
+                ) & can_push[:, None]
+                return jnp.where(sel[:, :, None, None], rest[:, None], stack)
+
+        lowered = jax.jit(push).lower(stack, rest, can_push, slot)
+
+    try:
+        lowered.compile()
+    except Exception as e:  # noqa: BLE001 - repro: report and exit nonzero
+        traceback.print_exc(limit=2)
+        print(f"COMPILE FAILED at lanes={L}: {type(e).__name__}: {e}"[:2000])
+        raise SystemExit(1)
+    print(f"COMPILE OK at lanes={L}")
+
+
+if __name__ == "__main__":
+    main()

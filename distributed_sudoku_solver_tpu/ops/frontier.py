@@ -116,6 +116,30 @@ class Frontier(NamedTuple):
     steals: jax.Array  # int32 scalar total bottom-steals
 
 
+def _seed_inverse(n_roots: int, n_lanes: int):
+    """Static inverse of the strided seed map floor(r * L / R).
+
+    Returns ``(root_of, is_seed, safe_root)``: which root (if any) seeds
+    each lane.  Injective because ``n_lanes >= n_roots``; sentinel
+    ``n_roots`` marks unseeded lanes.  Host-side int64 numpy — ``r * L``
+    overflows int32 beyond ~46k lanes, and shapes are static so this is
+    free at trace time.  Seeding via this gather (instead of same-index
+    top/has_top/job scatters) avoids XLA merging them into a variadic
+    scatter whose TPU emitter hits ``scatter_emitter.cc`` ``Check failed:
+    operand_indices.size() == 1 (2 vs. 1)`` at >= 131,072 lanes
+    (repro: ``benchmarks/repro_scatter131k.py --stage init``).
+    """
+    import numpy as np
+
+    seed_lane = (np.arange(n_roots, dtype=np.int64) * n_lanes) // n_roots
+    root_of_np = np.full(n_lanes, n_roots, np.int64)
+    root_of_np[seed_lane] = np.arange(n_roots)
+    root_of = jnp.asarray(root_of_np, jnp.int32)
+    is_seed = jnp.asarray(root_of_np < n_roots)
+    safe_root = jnp.clip(root_of, 0, n_roots - 1)
+    return root_of, is_seed, safe_root
+
+
 def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
     """Seed each job's root state into its own lane (the root TASK self-send,
     ``/root/reference/DHT_Node.py:551``); extra lanes start as thieves.
@@ -124,23 +148,20 @@ def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
     increasing since L >= J — so that when lanes are sharded over a mesh
     every chip starts with its share of root jobs instead of chip 0 holding
     everything.
+
+    Seeding is expressed as a *gather* (``states0[root_of_lane]``) rather
+    than scatters — see :func:`_seed_inverse` for the XLA:TPU variadic
+    scatter crash this avoids.
     """
     n_jobs, h, w = states0.shape
     n_lanes = config.resolve_lanes(n_jobs)
     s = config.stack_slots
-    # Host-side int64: j * L overflows int32 beyond ~46k lanes (shapes are
-    # static, so this is free at trace time).
-    import numpy as np
-
-    seed_lane = jnp.asarray(
-        (np.arange(n_jobs, dtype=np.int64) * n_lanes) // n_jobs, jnp.int32
+    root_of, is_seed, safe_root = _seed_inverse(n_jobs, n_lanes)
+    top = jnp.where(
+        is_seed[:, None, None], states0.astype(jnp.uint32)[safe_root], jnp.uint32(0)
     )
-    top = jnp.zeros((n_lanes, h, w), jnp.uint32)
-    top = top.at[seed_lane].set(states0.astype(jnp.uint32))
-    has_top = jnp.zeros(n_lanes, bool).at[seed_lane].set(True)
-    job = jnp.full(n_lanes, -1, jnp.int32).at[seed_lane].set(
-        jnp.arange(n_jobs, dtype=jnp.int32)
-    )
+    has_top = is_seed
+    job = jnp.where(is_seed, root_of, jnp.int32(-1))
     return Frontier(
         top=top,
         has_top=has_top,
@@ -171,21 +192,20 @@ def init_frontier_roots(
     partially-filled grid + guess range to a thief
     (``/root/reference/DHT_Node.py:502-509``).  Roots whose ``job_of_root``
     is -1 are padding and leave their lane idle (an immediate thief).
+
+    Gather-formulated like :func:`init_frontier` (see :func:`_seed_inverse`
+    for the variadic-scatter TPU compile crash this avoids); root
+    *validity* is dynamic, so it rides the gathered ``job_of_root``.
     """
     n_roots, h, w = roots.shape
     n_lanes = config.resolve_lanes(n_roots)
-    import numpy as np
-
-    seed_lane = jnp.asarray(
-        (np.arange(n_roots, dtype=np.int64) * n_lanes) // n_roots, jnp.int32
+    _, seeded, safe_root = _seed_inverse(n_roots, n_lanes)
+    is_seed = seeded & (job_of_root[safe_root] >= 0)
+    top = jnp.where(
+        is_seed[:, None, None], roots.astype(jnp.uint32)[safe_root], jnp.uint32(0)
     )
-    valid = job_of_root >= 0
-    lane_t = jnp.where(valid, seed_lane, n_lanes)  # invalid -> dropped scatter
-    top = jnp.zeros((n_lanes, h, w), jnp.uint32).at[lane_t].set(
-        roots.astype(jnp.uint32), mode="drop"
-    )
-    has_top = jnp.zeros(n_lanes, bool).at[lane_t].set(True, mode="drop")
-    job = jnp.full(n_lanes, -1, jnp.int32).at[lane_t].set(job_of_root, mode="drop")
+    has_top = is_seed
+    job = jnp.where(is_seed, job_of_root[safe_root], jnp.int32(-1))
     s = config.stack_slots
     return Frontier(
         top=top,
@@ -233,27 +253,28 @@ def init_frontier_packed(
         raise ValueError(
             f"{n_roots} roots exceed frontier capacity {n_lanes}x(1+{s})"
         )
-    lane_of = jnp.asarray(np.arange(n_roots) % n_lanes, jnp.int32)
-    slot_of = jnp.asarray(np.arange(n_roots) // n_lanes, jnp.int32)
+    # Gather-formulated (see init_frontier: same-index seeding scatters get
+    # merged into a variadic scatter that crashes the XLA:TPU emitter at
+    # giant lane counts).  Row r lands on lane r % L, slot r // L - 1; the
+    # inverse — which root belongs at (lane, slot) — is the static grid
+    # r = lane + (slot+1) * L, so every seed is a gather from ``roots``.
     valid = jnp.asarray(valid, bool)
+    rows = roots.astype(jnp.uint32)
 
-    is_top = valid & (slot_of == 0)
-    lane_top = jnp.where(is_top, lane_of, n_lanes)  # OOB -> dropped
-    top = jnp.zeros((n_lanes, h, w), jnp.uint32).at[lane_top].set(
-        roots.astype(jnp.uint32), mode="drop"
-    )
-    has_top = jnp.zeros(n_lanes, bool).at[lane_top].set(True, mode="drop")
-    job = jnp.full(n_lanes, -1, jnp.int32).at[lane_top].set(0, mode="drop")
+    r_top = np.arange(n_lanes)
+    top_exists = r_top < n_roots
+    safe_top = jnp.asarray(np.minimum(r_top, n_roots - 1), jnp.int32)
+    is_top = jnp.asarray(top_exists) & valid[safe_top]
+    top = jnp.where(is_top[:, None, None], rows[safe_top], jnp.uint32(0))
+    has_top = is_top
+    job = jnp.where(is_top, jnp.int32(0), jnp.int32(-1))
 
-    is_stack = valid & (slot_of >= 1)
-    lane_st = jnp.where(is_stack, lane_of, n_lanes)
-    slot_st = jnp.clip(slot_of - 1, 0, s - 1)
-    stack = jnp.zeros((n_lanes, s, h, w), jnp.uint32).at[lane_st, slot_st].set(
-        roots.astype(jnp.uint32), mode="drop"
-    )
-    count = jnp.zeros(n_lanes, jnp.int32).at[lane_st].add(
-        is_stack.astype(jnp.int32), mode="drop"
-    )
+    r_st = r_top[:, None] + (np.arange(s)[None, :] + 1) * n_lanes  # [L, S]
+    st_exists = r_st < n_roots
+    safe_st = jnp.asarray(np.minimum(r_st, n_roots - 1), jnp.int32)
+    is_stack = jnp.asarray(st_exists) & valid[safe_st]
+    stack = jnp.where(is_stack[:, :, None, None], rows[safe_st], jnp.uint32(0))
+    count = jnp.sum(is_stack, axis=1, dtype=jnp.int32)
     return Frontier(
         top=top,
         has_top=has_top,

@@ -20,8 +20,16 @@ process boundary in the middle of the mesh.
 
 import json
 import os
+import socket
 import subprocess
 import sys
+
+
+def free_port() -> int:
+    """Kernel-assigned free TCP port (shared by the multihost tests)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def spawn_mesh_pair(workdir, devices_per_proc: int = 4, timeout: float = 240):
@@ -36,11 +44,7 @@ def spawn_mesh_pair(workdir, devices_per_proc: int = 4, timeout: float = 240):
     TimeoutExpired), and a child that dies early can't orphan its sibling
     in a collective wait.
     """
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        coord = s.getsockname()[1]
+    coord = free_port()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)

@@ -13,10 +13,8 @@ import sys
 import time
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from multimesh_script import free_port as _free_port  # noqa: E402
 
 
 def test_cross_process_mesh(tmp_path):
@@ -32,7 +30,6 @@ def test_cross_process_mesh(tmp_path):
     """
     import numpy as np
 
-    sys.path.insert(0, os.path.dirname(__file__))
     from multimesh_script import spawn_mesh_pair
 
     pair = spawn_mesh_pair(tmp_path, devices_per_proc=4)
