@@ -81,6 +81,12 @@ class BulkConfig:
     first_pass_steps: int = 4096
     dispatch_steps: int = 512
     rung_stack_mb: int = 768  # cap on a rung's stack tensor (lanes x slots)
+    # First-pass step implementation: None = auto ('fused' whole-round VMEM
+    # kernel on TPU, 2.2x the composite step at 65,536 lanes — see
+    # BENCHMARKS.md round 3; 'xla' elsewhere).  Rungs always use the
+    # composite step: gang rungs live off steal reaction latency, which the
+    # fused path batches at fused_steps granularity.
+    step_impl: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.propagator not in (None, "xla", "pallas", "slices"):
@@ -89,6 +95,8 @@ class BulkConfig:
 
         if self.rules not in RULE_TIERS:
             raise ValueError(f"unknown rules {self.rules!r}")
+        if self.step_impl not in (None, "xla", "fused"):
+            raise ValueError(f"unknown step_impl {self.step_impl!r}")
 
 
 def default_rungs(geom: Geometry) -> tuple:
@@ -219,6 +227,17 @@ def solve_bulk(
     # rounding keeps compiled shapes O(log) across call sites.
     chunk = min(config.chunk, max(64, 1 << (max(b, 1) - 1).bit_length()))
     chunk = max(n_dev, -(-chunk // n_dev) * n_dev)
+    step_impl = config.step_impl
+    if step_impl == "fused" and mesh is not None:
+        # The sharded driver runs the composite step inside shard_map; a
+        # silent downgrade would mislabel A/B measurements.
+        raise ValueError("step_impl='fused' is single-chip only (mesh=None)")
+    if step_impl is None:
+        step_impl = (
+            "fused"
+            if (jax.default_backend() == "tpu" and mesh is None)
+            else "xla"
+        )
     first_cfg = SolverConfig(
         lanes=chunk,
         stack_slots=config.stack_slots,
@@ -226,6 +245,7 @@ def solve_bulk(
         max_sweeps=config.max_sweeps,
         propagator=prop,
         rules=config.rules,
+        step_impl=step_impl,
     )
 
     def drain(lo: int, res) -> None:

@@ -79,6 +79,11 @@ class SolverConfig:
     branch_k: int = 2  # 2 = binary guess-vs-rest; 3 = two singleton children
     #   + rest per expansion (shallower stacks, thief-ready second child;
     #   requires the problem to implement branch3 — Sudoku does)
+    step_impl: str = "xla"  # 'xla' (composite step, bit-exactness contract)
+    #   | 'fused' (whole-round VMEM Pallas kernel, ops/pallas_step.py:
+    #   k-step dispatches, purge/steal at that granularity — sound, not
+    #   bit-exact to 'xla'; batch solves only)
+    fused_steps: int = 8  # frontier rounds per fused-kernel dispatch
     steal: bool = True  # receiver-initiated work stealing between lanes
     steal_rounds: int = 1  # pairings per step; >1 ramps idle gangs up faster
     #   (a donor serves one thief per round, so a lone rich lane feeds at
@@ -89,6 +94,14 @@ class SolverConfig:
     def __post_init__(self) -> None:
         if self.branch_k not in (2, 3):
             raise ValueError(f"branch_k must be 2 or 3, got {self.branch_k}")
+        if self.step_impl not in ("xla", "fused"):
+            raise ValueError(f"unknown step_impl {self.step_impl!r}")
+        if self.step_impl == "fused" and self.branch_k != 2:
+            raise ValueError("step_impl='fused' supports branch_k=2 only")
+        if self.fused_steps < 1:
+            # 0 would make every fused dispatch a no-op: the driver's outer
+            # while (any live & steps < max) then spins forever in-graph.
+            raise ValueError(f"fused_steps must be >= 1, got {self.fused_steps}")
 
     def resolve_lanes(self, n_jobs: int) -> int:
         lanes = self.lanes if self.lanes > 0 else max(n_jobs, self.min_lanes)

@@ -1,0 +1,656 @@
+"""Whole-frontier-step Pallas kernel: pop/propagate/branch/push in VMEM.
+
+ROADMAP #2 (named since round 1, demanded by VERDICT r2 #1): the XLA
+composite step pays, per frontier round, a dispatch of ~30 fused XLA ops,
+two layout transposes inside the propagation backend, and HBM round trips
+between the propagate / classify / branch / push stages.  This module runs
+``k_steps`` *whole rounds* for a VMEM-resident tile of lanes inside ONE
+``pallas_call``:
+
+* the tile's tops, stacks, and per-lane counters load into VMEM once per
+  dispatch instead of once per round;
+* the state stays **boards-last** ``[n, n, T]`` across rounds (the layout
+  Mosaic vectorizes; the composite path transposes in and out every round);
+* the propagation fixpoint converges **per tile per round** — a tile of
+  easy lanes stops sweeping while another tile's hard lanes keep going,
+  where the composite path sweeps every lane until the *batch-global*
+  fixpoint;
+* branch, stack push (circular, ``(base+count) % S``), pop, solution
+  capture, and overflow accounting are slice-algebra on the same VMEM
+  block (``.at[].set`` scatters don't lower in Mosaic — pushes/pops are
+  static-S lane-masked concat trees).
+
+What stays OUTSIDE the kernel (XLA, between dispatches): job-level
+bookkeeping — first-lane-wins solution harvest, solved-job purge, per-job
+node accounting — and cross-lane work stealing.  Both need gather/scatter
+by *dynamic job id*, which Mosaic cannot express; batching them at
+``k_steps`` granularity changes only reaction latency (a lane may expand
+up to ``k_steps`` extra speculative nodes before purge/steal reaches it),
+never soundness.  The fused path is therefore a **gated strategy**
+(``SolverConfig.step_impl='fused'``) with its own verdict-soundness tests
+(``tests/test_fused_step.py``), not a bit-exact re-encoding of the XLA
+step — same contract as ``branch_k``.
+
+Reference bar: this is the hot loop of ``/root/reference/DHT_Node.py:
+474-538`` (recursive guess/validate/backtrack) as one resident TPU kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import operator
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+    _OR,
+    _VMEM,
+    _fixpoint_boards_last,
+    _group_reduce,
+    _interpret_default,
+)
+
+# meta rows (int32[META_ROWS, T]): kernel input state / output state+deltas
+_HAS_TOP, _BASE, _COUNT = 0, 1, 2
+_IN_ROWS = 3
+_SOLVED, _OVERFLOW, _NODES, _SWEEPS, _STEPS = 3, 4, 5, 6, 7
+_OUT_ROWS = 8
+
+# Python int (not a jnp scalar): pallas_call rejects captured constants.
+_BIG = 2**30
+
+
+def _bcast_reduce(x: jax.Array, axis: int, comb) -> jax.Array:
+    """Reduce ``axis`` to 1, then *materialize* the replication back to the
+    input shape with ``_expand`` (a concat of slice copies).
+
+    Deliberately NOT ``jnp.broadcast_to``: Mosaic tracks broadcast
+    provenance through elementwise ops, and a ``where`` whose CONDITION
+    has broadcast provenance poisons the layout of everything downstream —
+    loop-carried state then fails to legalize (``scf.yield``) or trips
+    ``array.h`` limit CHECKs (both observed on v5e).  ``_expand`` is the
+    sweep kernel's proven box-path idiom and yields natural layouts."""
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import _expand
+
+    r = _group_reduce(x, axis, x.shape[axis], comb)
+    return _expand(r, axis, x.shape[axis])
+
+
+def _full_any_i(x_i: jax.Array) -> jax.Array:
+    """int32 0/1 [n, n, T] -> cell-uniform board OR (still int32 0/1).
+
+    The whole status algebra stays in int32: ``_expand``/concat chains over
+    vector-i1 make Mosaic emit an invalid i1->i32 vreg bitcast (v5e).
+    """
+    return _bcast_reduce(_bcast_reduce(x_i, 0, _OR), 1, _OR)
+
+
+def _full_sum(x: jax.Array) -> jax.Array:
+    """int32[n, n, T] -> cell-uniform board sum."""
+    return _bcast_reduce(_bcast_reduce(x, 0, operator.add), 1, operator.add)
+
+
+def _full_min(x: jax.Array) -> jax.Array:
+    """int32[n, n, T] -> cell-uniform board minimum."""
+    return _bcast_reduce(_bcast_reduce(x, 0, jnp.minimum), 1, jnp.minimum)
+
+
+def _unit_full(x: jax.Array, geom: Geometry, comb):
+    """Unit reductions replicated back over [n, n, T] (rows/cols/boxes) —
+    ``_expand``-materialized, never broadcast (see :func:`_bcast_reduce`)."""
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import _expand
+
+    n, bh, bw = geom.n, geom.box_h, geom.box_w
+    row = _expand(_group_reduce(x, 1, n, comb), 1, n)
+    col = _expand(_group_reduce(x, 0, n, comb), 0, n)
+    box = _group_reduce(_group_reduce(x, 0, bh, comb), 1, bw, comb)
+    box = _expand(_expand(box, 0, bh), 1, bw)
+    return row, col, box
+
+
+def status_full(cand: jax.Array, geom: Geometry):
+    """Mosaic twin of ``ops.propagate.board_status`` on [n, n, T].
+
+    Same rules (no empty cell, no duplicated decided digit in a unit,
+    every digit keeps a home in every unit); returns cell-uniform
+    ``(solved, contra)`` as int32 0/1 masks — int32 end to end, see
+    :func:`_full_any_i` and :func:`_bcast_reduce` for the two Mosaic
+    layout/lowering constraints that shape this code.
+    """
+    single = jax.lax.population_count(cand) == 1
+    decided = jnp.where(single, cand, jnp.uint32(0))
+    full = jnp.uint32(geom.full_mask)
+
+    bad = _full_any_i(jnp.where(cand == 0, 1, 0))  # empty cell
+    # Sum == OR iff the decided singleton masks in a unit are distinct
+    # (masks are <= 1 << 24 at n=25, so int32 sums cannot overflow).
+    d_int = decided.astype(jnp.int32)
+    for unit_or, unit_sum in zip(
+        _unit_full(decided, geom, _OR),
+        _unit_full(d_int, geom, operator.add),
+    ):
+        bad = bad | _full_any_i(
+            jnp.where(unit_sum != unit_or.astype(jnp.int32), 1, 0)
+        )
+    for unit_or in _unit_full(cand, geom, _OR):
+        bad = bad | _full_any_i(jnp.where(unit_or != full, 1, 0))
+
+    undecided_any = _full_any_i(jnp.where(single, 0, 1))
+    contra = bad
+    solved = jnp.where((undecided_any == 0) & (bad == 0), 1, 0)
+    return solved, contra
+
+
+def branch_onehot_full(cand: jax.Array, geom: Geometry, rule: str):
+    """Mosaic twin of ``SudokuCSP._branch_cell_onehot`` on [n, n, T].
+
+    Identical cell choice: the packed key ``pc * n^2 + cell`` (or ``cell``
+    for 'first') is unique per cell, so its board-minimum IS the argmin
+    with the same lowest-cell tie-break.  Returns bool[n, n, T].
+    """
+    n = geom.n
+    pc = jax.lax.population_count(cand).astype(jnp.int32)
+    und = pc > 1
+    cell = (
+        jax.lax.broadcasted_iota(jnp.int32, cand.shape, 0) * n
+        + jax.lax.broadcasted_iota(jnp.int32, cand.shape, 1)
+    )
+    minrem_key = jnp.where(und, pc * (n * n) + cell, _BIG)
+    first_key = jnp.where(und, cell, _BIG)
+    if rule in ("minrem", "minrem-desc"):
+        key = minrem_key
+    elif rule == "first":
+        key = first_key
+    else:  # 'mixed': per-state hash picks the heuristic (parity of h)
+        h = _full_sum(pc * (cell + 1))
+        key = jnp.where((h & 1) == 0, minrem_key, first_key)
+    return (key == _full_min(key)) & und
+
+
+def _lowest_bit(x: jax.Array) -> jax.Array:
+    return x & (~x + jnp.uint32(1))
+
+
+def _highest_bit(x: jax.Array) -> jax.Array:
+    for s in (1, 2, 4, 8, 16):
+        x = x | (x >> jnp.uint32(s))
+    return x ^ (x >> jnp.uint32(1))
+
+
+def _select_slot(stack: jax.Array, sel_slot: jax.Array, active: jax.Array):
+    """Read stack[slot_l, :, :, l] per lane: OR of lane-masked static rows.
+
+    ``sel_slot`` int32[n, n, T] (cell-uniform per-lane slot), ``active``
+    bool[n, n, T]; inactive lanes read 0.  Exclusive masks make the
+    OR-fold exact.
+    """
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import _fold
+
+    s = stack.shape[0]
+    rows = [
+        jnp.where(active & (sel_slot == i), stack[i], jnp.uint32(0))
+        for i in range(s)
+    ]
+    return _fold(rows, _OR)
+
+
+def _write_slot(
+    stack: jax.Array, sel_slot: jax.Array, active: jax.Array, row: jax.Array
+) -> jax.Array:
+    """Write ``row`` into stack[slot_l, :, :, l] per active lane.
+
+    Static-S concat tree (``.at[].set`` scatters don't lower in Mosaic)."""
+    s = stack.shape[0]
+    parts = [
+        jnp.where(
+            (active & (sel_slot == i))[None],
+            row[None],
+            stack[i : i + 1],
+        )
+        for i in range(s)
+    ]
+    return jnp.concatenate(parts, axis=0)
+
+
+def _fused_kernel(
+    top_ref,
+    stack_ref,
+    has_ref,
+    base_ref,
+    cnt_ref,
+    out_top,
+    out_stack,
+    out_has,
+    out_base,
+    out_cnt,
+    out_solved,
+    out_over,
+    out_nodes,
+    out_sweeps,
+    out_steps,
+    out_sol,
+    *,
+    geom: Geometry,
+    rules: str,
+    branch_rule: str,
+    max_sweeps: int,
+    k_steps: int,
+):
+    """Run up to ``k_steps`` whole frontier rounds on one VMEM lane tile.
+
+    EVERY loop-carried per-lane quantity is a cell-uniform full-board
+    tensor [n, n, T] (the same value replicated across all n^2 cells): the
+    only layouts Mosaic reliably carries through ``lax.while_loop`` are
+    the sweep kernel's full-shape ones — [1, T] / [1, 1, T] lane rows and
+    double-reduced aggregates all fail to legalize the loop yield
+    (observed on v5e; see :func:`_bcast_reduce`).  The redundancy is free:
+    VPU lanes compute the same value n^2 times instead of once, and a tile
+    carries ~20 x 83 KB at 9x9.
+    """
+    top = top_ref[...]
+    stack = stack_ref[...]
+    shape = top.shape
+    s = stack.shape[0]
+    # Refs are full-shape cell-uniform [n, n, T] (the XLA driver
+    # materializes the replication in HBM): reads need no broadcast, so
+    # every kernel tensor starts with a natural layout.
+    has_top = has_ref[...]  # int32 0/1 cell-uniform ([n, n, T])
+    base = base_ref[...]
+    count = cnt_ref[...]
+    sol = jnp.zeros_like(top)
+    # Lane masks ride as int32 0/1, not bool: vector-i1 loop carries make
+    # Mosaic emit an invalid i1->i32 vreg bitcast on v5e.
+    solved_f = jnp.zeros(shape, jnp.int32)
+    overflow_f = jnp.zeros(shape, jnp.int32)
+    nodes_d = jnp.zeros(shape, jnp.int32)
+    sweeps_d = jnp.int32(0)
+    steps_d = jnp.int32(0)
+    pick_low = branch_rule != "minrem-desc"
+
+    def cond(c):
+        (top, stack, has_top, base, count, sol, solved_f, overflow_f,
+         nodes_d, sweeps_d, steps_d) = c
+        return jnp.any(has_top > 0) & (steps_d < k_steps)
+
+    def body(c):
+        (top, stack, has_top, base, count, sol, solved_f, overflow_f,
+         nodes_d, sweeps_d, steps_d) = c
+        live = has_top > 0
+        tops = jnp.where(live, top, jnp.uint32(0))
+        tops, n_sweeps = _fixpoint_boards_last(tops, geom, max_sweeps, rules)
+        slv, con = status_full(tops, geom)  # int32 0/1
+        top_solved = (slv > 0) & live
+        top_contra = (con > 0) & live
+
+        # Solution capture: the lane freezes (job-level first-win and the
+        # purge of sibling lanes happen in XLA between dispatches).
+        newly = top_solved & (solved_f == 0)
+        sol = jnp.where(newly, tops, sol)
+        solved_f = jnp.where(newly, 1, solved_f)
+
+        undecided = live & ~top_solved & ~top_contra
+        onehot = branch_onehot_full(tops, geom, branch_rule)
+        pick = _lowest_bit(tops) if pick_low else _highest_bit(tops)
+        guess = jnp.where(onehot, pick, tops)
+        rest = jnp.where(onehot, tops & ~pick, tops)
+
+        can_push = undecided & (count < s)
+        push_slot = (base + count) % s
+        stack = _write_slot(stack, push_slot, can_push, rest)
+        overflow_f = jnp.where(undecided & ~can_push, 1, overflow_f)
+        nodes_d = nodes_d + jnp.where(undecided, 1, 0)
+
+        resolved = top_contra  # solved lanes freeze; contra lanes pop
+        can_pop = resolved & (count > 0)
+        pop_slot = (base + count - 1) % s
+        popped = _select_slot(stack, pop_slot, can_pop)
+
+        top = jnp.where(undecided, guess, tops)
+        top = jnp.where(can_pop, popped, top)
+        has_top = jnp.where(
+            live & ~top_solved & ~(resolved & ~can_pop), 1, 0
+        )
+        count = count + jnp.where(can_push, 1, 0) - jnp.where(can_pop, 1, 0)
+        return (top, stack, has_top, base, count, sol, solved_f, overflow_f,
+                nodes_d, sweeps_d + n_sweeps, steps_d + 1)
+
+    (top, stack, has_top, base, count, sol, solved_f, overflow_f,
+     nodes_d, sweeps_d, steps_d) = jax.lax.while_loop(
+        cond, body,
+        (top, stack, has_top, base, count, sol, solved_f, overflow_f,
+         nodes_d, sweeps_d, steps_d),
+    )
+
+    out_top[...] = top
+    out_stack[...] = stack
+    out_sol[...] = sol
+    # Cell-uniform carries collapse to one [1, 1, T] slice at store time.
+    zero_row = jnp.zeros((1, 1, shape[-1]), jnp.int32)
+    out_has[...] = has_top[0:1, 0:1]
+    out_base[...] = base[0:1, 0:1]
+    out_cnt[...] = count[0:1, 0:1]
+    out_solved[...] = solved_f[0:1, 0:1]
+    out_over[...] = overflow_f[0:1, 0:1]
+    out_nodes[...] = nodes_d[0:1, 0:1]
+    out_sweeps[...] = zero_row + sweeps_d
+    out_steps[...] = zero_row + steps_d
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "geom", "rules", "branch_rule", "max_sweeps", "k_steps", "tile",
+        "interpret",
+    ),
+)
+def fused_rounds(
+    top_t: jax.Array,
+    stack_t: jax.Array,
+    has_top: jax.Array,
+    base: jax.Array,
+    count: jax.Array,
+    geom: Geometry,
+    rules: str = "extended",
+    branch_rule: str = "minrem",
+    max_sweeps: int = 64,
+    k_steps: int = 8,
+    tile: int = 256,
+    interpret: bool | None = None,
+):
+    """Advance every lane up to ``k_steps`` frontier rounds in VMEM tiles.
+
+    Boards-last state: ``top_t`` uint32[n, n, L], ``stack_t`` uint32
+    [S, n, n, L]; per-lane int32/bool vectors.  Returns ``(top_t, stack_t,
+    has_top, base, count, lane_solved, lane_sol_t, lane_overflow,
+    nodes_delta, sweeps_total, steps_max)``.
+    """
+    n = geom.n
+    n_lanes = top_t.shape[-1]
+    s = stack_t.shape[0]
+    interp = _interpret_default() if interpret is None else interpret
+    tile = min(tile, n_lanes)
+    if n_lanes % tile:
+        raise ValueError(f"lanes {n_lanes} not a multiple of tile {tile}")
+    n_tiles = n_lanes // tile
+
+    # Per-lane inputs ride as full-shape cell-uniform [n, n, L] HBM
+    # tensors (XLA materializes the broadcast): the kernel then never
+    # broadcasts on load.  ~3 extra [n, n, L] copies per dispatch, amortized
+    # over k_steps rounds.
+    full = lambda v: jnp.broadcast_to(  # noqa: E731
+        v.astype(jnp.int32)[None, None], (n, n, n_lanes)
+    )
+    kernel = functools.partial(
+        _fused_kernel,
+        geom=geom,
+        rules=rules,
+        branch_rule=branch_rule,
+        max_sweeps=max_sweeps,
+        k_steps=k_steps,
+    )
+    vmem = dict(memory_space=_VMEM) if (_VMEM is not None and not interp) else {}
+    lane_spec = lambda *lead: pl.BlockSpec(  # noqa: E731
+        (*lead, tile), lambda i: (*(0,) * len(lead), i), **vmem
+    )
+    row_shape = jax.ShapeDtypeStruct((1, 1, n_lanes), jnp.int32)
+    (out_top, out_stack, o_has, o_base, o_cnt, o_solved, o_over, o_nodes,
+     o_sweeps, o_steps, out_sol) = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            lane_spec(n, n),
+            lane_spec(s, n, n),
+            lane_spec(n, n),
+            lane_spec(n, n),
+            lane_spec(n, n),
+        ],
+        out_specs=(
+            lane_spec(n, n),
+            lane_spec(s, n, n),
+            *([lane_spec(1, 1)] * 8),
+            lane_spec(n, n),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(top_t.shape, jnp.uint32),
+            jax.ShapeDtypeStruct(stack_t.shape, jnp.uint32),
+            *([row_shape] * 8),
+            jax.ShapeDtypeStruct(top_t.shape, jnp.uint32),
+        ),
+        interpret=interp,
+    )(top_t, stack_t, full(has_top), full(base), full(count))
+
+    # Per-tile scalars live broadcast in their rows; sum one lane per tile.
+    tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+    sweeps_total = jnp.sum(o_sweeps[0, 0][tile_starts])
+    steps_max = jnp.max(o_steps[0, 0][tile_starts])
+    return (
+        out_top,
+        out_stack,
+        o_has[0, 0] > 0,
+        o_base[0, 0],
+        o_cnt[0, 0],
+        o_solved[0, 0] > 0,
+        out_sol,
+        o_over[0, 0] > 0,
+        o_nodes[0, 0],
+        sweeps_total,
+        steps_max,
+    )
+
+
+# --------------------------------------------------------------------------
+# XLA driver: job bookkeeping + cross-lane stealing between kernel dispatches.
+# --------------------------------------------------------------------------
+
+
+class FusedFrontier(NamedTuple):
+    """Boards-last loop state for the fused driver (lane axis LAST)."""
+
+    top_t: jax.Array  # uint32[n, n, L]
+    stack_t: jax.Array  # uint32[S, n, n, L]
+    has_top: jax.Array  # bool[L]
+    base: jax.Array  # int32[L]
+    count: jax.Array  # int32[L]
+    job: jax.Array  # int32[L]
+    solved: jax.Array  # bool[J]
+    solution_t: jax.Array  # uint32[n, n, J]
+    overflowed: jax.Array  # bool[J]
+    nodes: jax.Array  # int32[J]
+    steps: jax.Array  # int32
+    sweeps: jax.Array  # int32
+    expansions: jax.Array  # int32
+    steals: jax.Array  # int32
+
+
+def _steal_t(top_t, has_top, stack_t, base, count, job, job_live):
+    """``ops.frontier._steal`` on boards-last tensors (lane axis last).
+
+    Same prefix-sum rank pairing; row movement is a slot gather
+    (``take_along_axis`` over S) + lane-axis gather/scatter.
+    """
+    from distributed_sudoku_solver_tpu.ops.frontier import _lane_by_rank
+
+    n_lanes = has_top.shape[0]
+    s = stack_t.shape[0]
+    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
+
+    idle = ~has_top
+    donor = has_top & (count >= 1) & job_live
+    n_pairs = jnp.minimum(jnp.sum(idle), jnp.sum(donor)).astype(jnp.int32)
+
+    thief_of = _lane_by_rank(idle, n_lanes)
+    donor_of = _lane_by_rank(donor, n_lanes)
+    pair = lane_idx < n_pairs
+    thief_lane = jnp.where(pair, thief_of, n_lanes)
+    donor_lane = jnp.where(pair, donor_of, n_lanes)
+    safe_donor = jnp.clip(donor_lane, 0, n_lanes - 1)
+
+    bottom = jnp.take_along_axis(
+        stack_t, (base % s)[None, None, None, :], axis=0
+    )[0]  # [n, n, L]: each lane's bottom stack row
+    stolen = bottom[:, :, safe_donor]
+    top_t = top_t.at[:, :, thief_lane].set(stolen, mode="drop")
+    has_top = has_top.at[thief_lane].set(pair, mode="drop")
+    job = job.at[thief_lane].set(job[safe_donor], mode="drop")
+
+    donor_sel = (
+        jnp.zeros(n_lanes, bool)
+        .at[jnp.where(pair, donor_lane, n_lanes)]
+        .set(True, mode="drop")
+    )
+    base = jnp.where(donor_sel, (base + 1) % s, base)
+    count = jnp.where(donor_sel, count - 1, count)
+    return top_t, has_top, base, count, job, n_pairs
+
+
+def _fused_round(fs: FusedFrontier, geom: Geometry, config) -> FusedFrontier:
+    """One kernel dispatch (k_steps rounds) + the XLA-side job bookkeeping."""
+    n_jobs = fs.solved.shape[0]
+    n_lanes = fs.has_top.shape[0]
+    job_safe = jnp.clip(fs.job, 0, n_jobs - 1)
+
+    (top_t, stack_t, has_top, base, count, lane_solved, lane_sol_t,
+     lane_over, nodes_d, sweeps_t, steps_m) = fused_rounds(
+        fs.top_t, fs.stack_t, fs.has_top, fs.base, fs.count,
+        geom,
+        rules=config.rules,
+        branch_rule=config.branch,
+        max_sweeps=config.max_sweeps,
+        k_steps=config.fused_steps,
+        # 128-lane tiles: the full-shape carries + fixpoint temporaries of a
+        # 256-lane tile overflow the 16 MB scoped-VMEM budget at 9x9.
+        tile=min(128, n_lanes),
+    )
+
+    # First-lane-wins harvest per job (the composite step's exact rule).
+    eligible = lane_solved & (fs.job >= 0) & ~fs.solved[job_safe]
+    scatter_job = jnp.where(eligible, fs.job, n_jobs)
+    lane_ids = jnp.arange(n_lanes, dtype=jnp.int32)
+    first = jnp.full(n_jobs, n_lanes, jnp.int32).at[scatter_job].min(
+        jnp.where(eligible, lane_ids, n_lanes), mode="drop"
+    )
+    newly = (first < n_lanes) & ~fs.solved
+    sol_rows = lane_sol_t[:, :, jnp.clip(first, 0, n_lanes - 1)]
+    solution_t = jnp.where(newly[None, None, :], sol_rows, fs.solution_t)
+    solved = fs.solved | newly
+
+    live_jobs = fs.job >= 0
+    overflowed = fs.overflowed.at[
+        jnp.where(lane_over & live_jobs, fs.job, n_jobs)
+    ].set(True, mode="drop")
+    nodes = fs.nodes.at[jnp.where(live_jobs, fs.job, n_jobs)].add(
+        nodes_d, mode="drop"
+    )
+
+    # Purge lanes of resolved jobs, then rebalance (receiver-initiated).
+    job_live = live_jobs & ~solved[job_safe]
+    has_top = has_top & job_live
+    count = jnp.where(job_live, count, 0)
+    job = fs.job
+    n_steals = jnp.int32(0)
+    if config.steal:
+        top_t, has_top, base, count, job, n_steals = _steal_t(
+            top_t, has_top, stack_t, base, count, job, job_live
+        )
+
+    return FusedFrontier(
+        top_t=top_t,
+        stack_t=stack_t,
+        has_top=has_top,
+        base=base,
+        count=count,
+        job=job,
+        solved=solved,
+        solution_t=solution_t,
+        overflowed=overflowed,
+        nodes=nodes,
+        steps=fs.steps + steps_m,
+        sweeps=fs.sweeps + sweeps_t,
+        expansions=fs.expansions + jnp.sum(nodes_d),
+        steals=fs.steals + n_steals,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config"))
+def solve_batch_fused(
+    grids: jax.Array, geom: Geometry, config
+):
+    """Fused-step batched Sudoku solve (``SolverConfig.step_impl='fused'``).
+
+    Same contract as ``ops.solve.solve_batch`` (solved / proven-unsat /
+    unknown verdicts, int-grid solutions) under the fused round semantics:
+    purge/steal react at ``fused_steps`` granularity, so node counts differ
+    from the composite step while every verdict stays sound
+    (``tests/test_fused_step.py``).
+    """
+    import dataclasses
+
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier
+    from distributed_sudoku_solver_tpu.ops.solve import (
+        SolveResult,
+        _decode_solution,
+    )
+
+    # Round the lane count up to a multiple of the kernel tile (128) so the
+    # grid divides evenly — the composite path has no such constraint, and
+    # a raise on e.g. 200 lanes would leak a kernel implementation detail.
+    # Extra lanes start idle and join as thieves, exactly like min_lanes
+    # slack.
+    n_jobs = grids.shape[0]
+    lanes = config.resolve_lanes(n_jobs)
+    if lanes > 128:
+        lanes = -(-lanes // 128) * 128
+    config = dataclasses.replace(config, lanes=lanes)
+
+    state = init_frontier(encode_grid(grids, geom), config)
+    n_jobs = state.solved.shape[0]
+    fs = FusedFrontier(
+        top_t=state.top.transpose(1, 2, 0),
+        stack_t=state.stack.transpose(1, 2, 3, 0),
+        has_top=state.has_top,
+        base=state.base,
+        count=state.count,
+        job=state.job,
+        solved=state.solved,
+        solution_t=state.solution.transpose(1, 2, 0),
+        overflowed=state.overflowed,
+        nodes=state.nodes,
+        steps=state.steps,
+        sweeps=state.sweeps,
+        expansions=state.expansions,
+        steals=state.steals,
+    )
+
+    def live(fs: FusedFrontier):
+        job_safe = jnp.clip(fs.job, 0, n_jobs - 1)
+        return fs.has_top & (fs.job >= 0) & ~fs.solved[job_safe]
+
+    def cond(fs: FusedFrontier):
+        return jnp.any(live(fs)) & (fs.steps < config.max_steps)
+
+    fs = jax.lax.while_loop(
+        cond, lambda f: _fused_round(f, geom, config), fs
+    )
+
+    job_safe = jnp.clip(fs.job, 0, n_jobs - 1)
+    job_has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(
+        live(fs), mode="drop"
+    )
+    unsat = ~fs.solved & ~job_has_work & ~fs.overflowed
+    res = SolveResult(
+        solution=fs.solution_t.transpose(2, 0, 1),
+        solved=fs.solved,
+        unsat=unsat,
+        overflowed=fs.overflowed,
+        nodes=fs.nodes,
+        steps=fs.steps,
+        sweeps=fs.sweeps,
+        expansions=fs.expansions,
+        steals=fs.steals,
+    )
+    return _decode_solution(res)

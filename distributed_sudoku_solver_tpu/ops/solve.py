@@ -93,6 +93,13 @@ def solve_csp(
     states0: jax.Array, problem: CSProblem, config: SolverConfig = SolverConfig()
 ) -> SolveResult:
     """Solve root states [J, h, w] of any CSP; solution is the raw solved state."""
+    if config.step_impl == "fused":
+        # The fused kernel hardcodes the Sudoku kernels; a silent composite
+        # fallback would mislabel A/B measurements (the branch_k precedent).
+        raise ValueError(
+            "step_impl='fused' supports the Sudoku entry points only; "
+            f"got a generic {type(problem).__name__}"
+        )
     state = init_frontier(states0, config)
     state = run_frontier(state, problem, config)
     return finalize_frontier(state)
@@ -103,6 +110,12 @@ def solve_batch(
     grids: jax.Array, geom: Geometry, config: SolverConfig = SolverConfig()
 ) -> SolveResult:
     """Solve int grids [J, n, n] (0 = empty); one compiled program per (J, geom, config)."""
+    if config.step_impl == "fused":
+        from distributed_sudoku_solver_tpu.ops.pallas_step import (
+            solve_batch_fused,
+        )
+
+        return solve_batch_fused(jnp.asarray(grids), geom, config)
     cand0 = encode_grid(grids, geom)
     state = init_frontier(cand0, config)
     state = run_frontier(state, sudoku_csp(geom, config), config)
@@ -122,10 +135,7 @@ def solve_batch_wire(
     from distributed_sudoku_solver_tpu.ops import wire
 
     grids = wire.unpack_grids_device(packed, geom)
-    cand0 = encode_grid(grids, geom)
-    state = init_frontier(cand0, config)
-    state = run_frontier(state, sudoku_csp(geom, config), config)
-    res = _finalize(state)
+    res = solve_batch(grids, geom, config)  # one step_impl dispatch site
     return wire.pack_result_device(
         res.solution, res.solved, res.unsat, res.nodes > 0, geom
     )
