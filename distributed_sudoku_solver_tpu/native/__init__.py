@@ -99,6 +99,12 @@ def load() -> Optional[ctypes.CDLL]:
             i32p, ctypes.c_int64, ctypes.c_int, ctypes.c_char_p,
         ]
         lib.csp_format_boards.restype = ctypes.c_int64
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        lib.cover_count_solutions.argtypes = [
+            u32p, u32p, u32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int64, i64p,
+        ]
+        lib.cover_count_solutions.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -210,3 +216,35 @@ def format_boards(boards) -> bytes:
     buf = ctypes.create_string_buffer(count * (n * n + 1))
     written = int(lib.csp_format_boards(g.reshape(-1), count, n, buf))
     return buf.raw[:written]
+
+
+def cover_count(problem, limit: int = -1) -> Tuple[int, int]:
+    """Count exact-cover solutions of an ``ExactCoverCSP`` natively.
+
+    Runs the recursive MRV DFS in ``src/solver.cc`` over the *identical*
+    packed matrix the device engine searches (``col_rows``/``row_cols``/
+    ``elim``), so device-vs-native rows in ``benchmarks/bench_cover.py``
+    compare search engines, not encodings.  Returns ``(count, nodes)``;
+    ``limit < 0`` enumerates everything.
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native solver unavailable (no compiler?)")
+    col_rows = np.ascontiguousarray(problem.col_rows, dtype=np.uint32)
+    row_cols = np.ascontiguousarray(problem.row_cols, dtype=np.uint32)
+    elim = np.ascontiguousarray(problem.elim, dtype=np.uint32)
+    nodes = ctypes.c_int64(0)
+    rc = lib.cover_count_solutions(
+        col_rows.reshape(-1),
+        row_cols.reshape(-1),
+        elim.reshape(-1),
+        problem.n_rows,
+        problem.n_primary,
+        elim.shape[1],
+        row_cols.shape[1],
+        limit,
+        ctypes.byref(nodes),
+    )
+    if rc < 0:
+        raise ValueError("malformed cover instance")
+    return int(rc), int(nodes.value)

@@ -195,3 +195,94 @@ int csp_solve_batch(int32_t* grids, int count, int n, int box_h, int box_w,
 }
 
 }  // extern "C"
+
+namespace {
+
+// Generalized exact cover, counting all solutions.  Operates on the exact
+// arrays models/cover.py::ExactCoverCSP carries (col_rows / row_cols /
+// elim as packed uint32 words), so the native baseline and the TPU engine
+// search the *identical* matrix — the benchmark contract of
+// benchmarks/bench_cover.py.  MRV column choice (fewest available rows),
+// ascending row order within a column: the same heuristic family as the
+// device kernels, recursion instead of lane stacks.
+struct CoverSearcher {
+  const uint32_t* col_rows;  // [n_primary][w_rows]
+  const uint32_t* row_cols;  // [n_rows][w_cols]
+  const uint32_t* elim;      // [n_rows][w_rows]
+  int n_rows, n_primary, w_rows, w_cols;
+  int64_t limit;
+  int64_t found = 0;
+  int64_t nodes = 0;
+
+  static int popcount_and(const uint32_t* a, const uint32_t* b, int w) {
+    int c = 0;
+    for (int i = 0; i < w; ++i) c += __builtin_popcount(a[i] & b[i]);
+    return c;
+  }
+
+  void dfs(uint32_t* avail, uint32_t* covered) {
+    if (limit >= 0 && found >= limit) return;
+    // MRV: the uncovered primary column with the fewest available rows.
+    int best_col = -1, best_cnt = INT32_MAX;
+    for (int c = 0; c < n_primary; ++c) {
+      if ((covered[c >> 5] >> (c & 31)) & 1u) continue;
+      const int cnt = popcount_and(col_rows + c * w_rows, avail, w_rows);
+      if (cnt < best_cnt) {
+        best_cnt = cnt;
+        best_col = c;
+        if (cnt == 0) break;
+      }
+    }
+    if (best_col == -1) {  // every primary column covered: one solution
+      ++found;
+      return;
+    }
+    if (best_cnt == 0) return;  // dead end
+    const uint32_t* crow = col_rows + best_col * w_rows;
+    uint32_t navail[128], ncovered[128];  // w_rows, w_cols <= 128 words each
+    for (int r = 0; r < n_rows; ++r) {
+      if (!((crow[r >> 5] >> (r & 31)) & (avail[r >> 5] >> (r & 31)) & 1u)) {
+        continue;
+      }
+      ++nodes;
+      const uint32_t* el = elim + r * w_rows;
+      for (int i = 0; i < w_rows; ++i) navail[i] = avail[i] & ~el[i];
+      navail[r >> 5] &= ~(1u << (r & 31));
+      const uint32_t* rc = row_cols + r * w_cols;
+      for (int i = 0; i < w_cols; ++i) ncovered[i] = covered[i] | rc[i];
+      dfs(navail, ncovered);
+      if (limit >= 0 && found >= limit) return;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Count exact-cover solutions up to `limit` (< 0 = unlimited).
+// Returns the count, or -1 on malformed sizes.
+int64_t cover_count_solutions(const uint32_t* col_rows,
+                              const uint32_t* row_cols, const uint32_t* elim,
+                              int n_rows, int n_primary, int w_rows,
+                              int w_cols, int64_t limit, int64_t* nodes_out) {
+  if (n_rows < 1 || n_primary < 1 || w_rows < 1 || w_rows > 128 ||
+      w_cols < 1 || w_cols > 128 || n_rows > 32 * w_rows ||
+      n_primary > 32 * w_cols) {
+    return -1;
+  }
+  CoverSearcher s{col_rows, row_cols, elim, n_rows, n_primary, w_rows,
+                  w_cols, limit};
+  uint32_t avail[128], covered[128];
+  for (int i = 0; i < w_rows; ++i) {
+    avail[i] = 0xffffffffu;
+  }
+  const int tail = n_rows & 31;
+  if (tail) avail[w_rows - 1] = (1u << tail) - 1u;
+  for (int i = 0; i < w_cols; ++i) covered[i] = 0u;
+  s.dfs(avail, covered);
+  if (nodes_out != nullptr) *nodes_out = s.nodes;
+  return s.found;
+}
+
+}  // extern "C"

@@ -79,6 +79,12 @@ class SolverConfig:
     branch_k: int = 2  # 2 = binary guess-vs-rest; 3 = two singleton children
     #   + rest per expansion (shallower stacks, thief-ready second child;
     #   requires the problem to implement branch3 — Sudoku does)
+    count_all: bool = False  # enumerate ALL solutions: jobs never resolve
+    #   on a solve — each solved top bumps its job's sol_count and the lane
+    #   pops its next subtree, so the search runs to exhaustion and
+    #   ``sol_count`` is the exact model count (a lower bound if overflowed).
+    #   Single-device entry points still return the first solution found
+    #   per job; the lane-sharded path returns counts only (zeros solution).
     step_impl: str = "xla"  # 'xla' (composite step, bit-exactness contract)
     #   | 'fused' (whole-round VMEM Pallas kernel, ops/pallas_step.py:
     #   k-step dispatches, purge/steal at that granularity — sound, not
@@ -98,6 +104,10 @@ class SolverConfig:
             raise ValueError(f"unknown step_impl {self.step_impl!r}")
         if self.step_impl == "fused" and self.branch_k != 2:
             raise ValueError("step_impl='fused' supports branch_k=2 only")
+        if self.step_impl == "fused" and self.count_all:
+            # The fused kernel freezes a lane on its first solve; silent
+            # undercounts would mislabel enumeration results.
+            raise ValueError("count_all is not supported with step_impl='fused'")
         if self.fused_steps < 1:
             # 0 would make every fused dispatch a no-op: the driver's outer
             # while (any live & steps < max) then spins forever in-graph.
@@ -123,6 +133,7 @@ class Frontier(NamedTuple):
     solution: jax.Array  # uint32[J, h, w] (solved problem state)
     overflowed: jax.Array  # bool[J] some subtree was dropped (stack full)
     nodes: jax.Array  # int32[J] branch nodes expanded per job
+    sol_count: jax.Array  # int32[J] solutions found (count_all enumeration)
     steps: jax.Array  # int32 scalar
     sweeps: jax.Array  # int32 scalar total propagation sweeps
     expansions: jax.Array  # int32 scalar total branch expansions
@@ -186,6 +197,7 @@ def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
         solution=jnp.zeros((n_jobs, h, w), jnp.uint32),
         overflowed=jnp.zeros(n_jobs, bool),
         nodes=jnp.zeros(n_jobs, jnp.int32),
+        sol_count=jnp.zeros(n_jobs, jnp.int32),
         steps=jnp.int32(0),
         sweeps=jnp.int32(0),
         expansions=jnp.int32(0),
@@ -231,6 +243,7 @@ def init_frontier_roots(
         solution=jnp.zeros((n_jobs, h, w), jnp.uint32),
         overflowed=jnp.zeros(n_jobs, bool),
         nodes=jnp.zeros(n_jobs, jnp.int32),
+        sol_count=jnp.zeros(n_jobs, jnp.int32),
         steps=jnp.int32(0),
         sweeps=jnp.int32(0),
         expansions=jnp.int32(0),
@@ -299,6 +312,7 @@ def init_frontier_packed(
         solution=jnp.zeros((1, h, w), jnp.uint32),
         overflowed=jnp.zeros(1, bool),
         nodes=jnp.zeros(1, jnp.int32),
+        sol_count=jnp.zeros(1, jnp.int32),
         steps=jnp.int32(0),
         sweeps=jnp.int32(0),
         expansions=jnp.int32(0),
@@ -451,10 +465,24 @@ def frontier_step(
     first = jnp.full(n_jobs, n_lanes, jnp.int32).at[scatter_job].min(
         jnp.where(solved_tops, lane_idx, n_lanes), mode="drop"
     )
-    newly = (first < n_lanes) & ~state.solved
+    had_sol = state.sol_count > 0
+    newly = (first < n_lanes) & ~state.solved & ~had_sol
     sol_rows = tops[jnp.clip(first, 0, n_lanes - 1)]
     solution = jnp.where(newly[:, None, None], sol_rows, state.solution)
-    solved = state.solved | newly
+    if config.count_all:
+        # Enumeration: the job never resolves on a solve — every solved top
+        # this round is counted and its lane pops the next subtree below,
+        # so the search runs to exhaustion and sol_count is the exact
+        # model count.
+        sol_count = state.sol_count.at[scatter_job].add(
+            solved_tops.astype(jnp.int32), mode="drop"
+        )
+        solved = state.solved
+    else:
+        # Normal mode: exactly the job-resolution event — two lanes of one
+        # job solving in the same round must still count once.
+        sol_count = state.sol_count + newly.astype(jnp.int32)
+        solved = state.solved | newly
 
     # --- branch: guess becomes the new top, sibling rows are pushed ---------
     if config.branch_k == 3 and not hasattr(problem, "branch3"):
@@ -538,6 +566,7 @@ def frontier_step(
         solution=solution,
         overflowed=overflowed,
         nodes=nodes,
+        sol_count=sol_count,
         steps=state.steps + 1,
         sweeps=state.sweeps + sweeps,
         expansions=state.expansions + jnp.sum(undecided).astype(jnp.int32),

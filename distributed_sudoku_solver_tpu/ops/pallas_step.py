@@ -648,6 +648,7 @@ def solve_batch_fused(
         unsat=unsat,
         overflowed=fs.overflowed,
         nodes=fs.nodes,
+        sol_count=fs.solved.astype(jnp.int32),
         steps=fs.steps,
         sweeps=fs.sweeps,
         expansions=fs.expansions,

@@ -36,9 +36,13 @@ class SolveResult(NamedTuple):
     solution: jax.Array  # solved state per job (int32 grid for Sudoku entry
     #   points; raw uint32[h, w] problem state for solve_csp); zeros if unsolved
     solved: jax.Array  # bool[J]
-    unsat: jax.Array  # bool[J]: proven unsatisfiable
+    unsat: jax.Array  # bool[J]: search space exhausted with no resolution —
+    #   proven unsatisfiable normally; under SolverConfig.count_all it means
+    #   the enumeration ran to completion (sol_count is exact)
     overflowed: jax.Array  # bool[J]: a subtree was dropped (stack overflow)
     nodes: jax.Array  # int32[J] branch nodes expanded ("validations" analog)
+    sol_count: jax.Array  # int32[J] solutions found (== 1/0 normally; the
+    #   exact model count under SolverConfig.count_all enumeration)
     steps: jax.Array  # int32 frontier rounds
     sweeps: jax.Array  # int32 total propagation sweeps
     expansions: jax.Array  # int32 total branch expansions
@@ -58,6 +62,7 @@ def finalize_frontier(state: Frontier) -> SolveResult:
         unsat=unsat,
         overflowed=state.overflowed,
         nodes=state.nodes,
+        sol_count=state.sol_count,
         steps=state.steps,
         sweeps=state.sweeps,
         expansions=state.expansions,
@@ -66,9 +71,13 @@ def finalize_frontier(state: Frontier) -> SolveResult:
 
 
 def _decode_solution(res: SolveResult) -> SolveResult:
-    """Sudoku entry points return int grids, not candidate masks."""
+    """Sudoku entry points return int grids, not candidate masks.
+
+    ``sol_count > 0`` keeps the first-found solution visible under
+    ``count_all`` enumeration, where ``solved`` stays False by design."""
+    has_sol = res.solved | (res.sol_count > 0)
     solution = jnp.where(
-        res.solved[:, None, None], decode_grid(res.solution), jnp.int32(0)
+        has_sol[:, None, None], decode_grid(res.solution), jnp.int32(0)
     )
     return res._replace(solution=solution)
 

@@ -498,6 +498,7 @@ def _solve_banded_jit(
         solution=P(None, axis, None),
         overflowed=P(),
         nodes=P(),
+        sol_count=P(),
         steps=P(),
         sweeps=P(),
         expansions=P(),

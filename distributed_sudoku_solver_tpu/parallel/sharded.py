@@ -170,6 +170,7 @@ def _sharded_step(
         solution=solution,
         overflowed=overflowed,
         nodes=st.nodes,
+        sol_count=st.sol_count,
         steps=st.steps,
         sweeps=st.sweeps,
         expansions=st.expansions,
@@ -204,6 +205,7 @@ def _run_sharded(
         unsat=unsat,
         overflowed=res.overflowed,
         nodes=jax.lax.psum(res.nodes, axis),
+        sol_count=jax.lax.psum(res.sol_count, axis),
         steps=res.steps,
         sweeps=jax.lax.psum(res.sweeps, axis),
         expansions=jax.lax.psum(res.expansions, axis),
@@ -238,6 +240,7 @@ def _solve_csp_sharded_jit(
         solution=P(),
         overflowed=P(),
         nodes=P(),
+        sol_count=P(),
         steps=P(),
         sweeps=P(),
         expansions=P(),
@@ -249,6 +252,7 @@ def _solve_csp_sharded_jit(
         unsat=P(),
         overflowed=P(),
         nodes=P(),
+        sol_count=P(),
         steps=P(),
         sweeps=P(),
         expansions=P(),

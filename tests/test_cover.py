@@ -102,3 +102,62 @@ def test_cover_sharded_on_mesh():
     assert bool(res.solved[0])
     q = decode_queens(p, np.asarray(res.solution[0]), 10)
     assert is_valid_queens(q, 10)
+
+
+def test_count_all_nqueens_exact():
+    """count_all enumeration: exact model counts on instances with known
+    answers, matching the native C++ DFS over the identical matrix."""
+    from distributed_sudoku_solver_tpu import native
+
+    for n, expect in [(6, 4), (8, 92)]:
+        p = nqueens_cover(n)
+        cfg = SolverConfig(
+            min_lanes=64, stack_slots=128, max_steps=100_000, count_all=True
+        )
+        res = solve_csp(_roots(p), p, cfg)
+        assert int(res.sol_count[0]) == expect, f"n={n}"
+        assert bool(res.unsat[0])  # exhausted == enumeration complete
+        assert not bool(res.overflowed[0])
+        if native.available():
+            cnt, _ = native.cover_count(p)
+            assert cnt == expect
+
+
+def test_count_all_empty_4x4_sudoku():
+    """All 288 complete 4x4 Sudoku grids, enumerated by the Sudoku path."""
+    import jax.numpy as jnp
+
+    empty = np.zeros((1, 4, 4), np.int32)
+    cfg = SolverConfig(
+        min_lanes=32, stack_slots=64, max_steps=100_000, count_all=True
+    )
+    res = solve_batch(jnp.asarray(empty), SUDOKU_4, cfg)
+    assert int(res.sol_count[0]) == 288
+    assert bool(res.unsat[0])
+    # The first-found solution stays visible even though `solved` is False
+    # by design under enumeration.
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+
+    assert is_valid_solution(np.asarray(res.solution[0]), SUDOKU_4)
+
+
+def test_sol_count_in_normal_mode():
+    """Without count_all: sol_count is exactly 1 for solved jobs, 0 else,
+    and verdicts are untouched (the field is additive, not behavioral)."""
+    p = nqueens_cover(8)
+    res = solve_csp(_roots(p), p, CFG)
+    assert bool(res.solved[0])
+    assert int(res.sol_count[0]) == 1
+
+
+def test_count_all_overflow_is_lower_bound():
+    """A 1-slot stack drops subtrees: overflow is flagged so the count is
+    reported as a lower bound, never silently wrong."""
+    p = nqueens_cover(8)
+    cfg = SolverConfig(
+        lanes=1, min_lanes=1, stack_slots=1, max_steps=100_000,
+        count_all=True, steal=False,
+    )
+    res = solve_csp(_roots(p), p, cfg)
+    assert bool(res.overflowed[0])
+    assert int(res.sol_count[0]) <= 92

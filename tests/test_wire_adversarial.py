@@ -143,9 +143,27 @@ def test_churn_soak_under_load():
     converge back to the survivor set after every cycle.
 
     Duration defaults to ~40 s; set ``DSST_SOAK_SECS`` for a long-haul lane
-    (e.g. ``DSST_SOAK_SECS=1800 pytest -m slow -k churn``).
+    (e.g. ``DSST_SOAK_SECS=7200 pytest -m slow -k churn`` for the 2-hour
+    leak lane, VERDICT r2 #6).
+
+    Leak assertions: RSS and open-fd counts are sampled throughout; after
+    a warmup third (compile caches and socket pools legitimately grow
+    early), the fitted RSS slope must stay under 1 MB/min and the fd count
+    must return to within a small constant of its post-warmup level — so a
+    slow per-cycle leak in the engine/cluster threads fails the lane
+    instead of passing every functional check (VERDICT r2 weak #7).
     """
     import os
+
+    def rss_mb() -> float:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+        return 0.0
+
+    def fd_count() -> int:
+        return len(os.listdir("/proc/self/fd"))
 
     soak_secs = float(os.environ.get("DSST_SOAK_SECS", "40"))
     a = make_node()
@@ -153,21 +171,43 @@ def test_churn_soak_under_load():
     assert wait_for(lambda: len(a.network) == 3, timeout=30)
 
     results = []
+    done_ok = [0]
+    pump_failures: list[str] = []
     stop = threading.Event()
+    samples: list[tuple[float, float, int]] = []  # (t, rss_mb, fds)
 
     def pump():
         while not stop.is_set():
             job = a.submit(EASY_9)
             results.append(job)
             time.sleep(0.05)
+            # Validate-and-discard resolved jobs as we go: retaining every
+            # handle (with its solution array) for hours would read as an
+            # RSS leak in the measurement below — harness growth, not a
+            # product leak.  Failures are recorded, not asserted: an
+            # AssertionError in a daemon thread dies silently and the
+            # popped job would vanish from the finally-block recheck.
+            while results and results[0].done.is_set():
+                j = results.pop(0)
+                if not j.solved:
+                    pump_failures.append(f"job {j.uuid} ended unsolved")
+                    stop.set()
+                    return
+                done_ok[0] += 1
 
     pump_t = threading.Thread(target=pump, daemon=True)
     pump_t.start()
     try:
-        deadline = time.monotonic() + soak_secs
+        t0 = time.monotonic()
+        deadline = t0 + soak_secs
+        sample_every = max(5.0, soak_secs / 120.0)  # <= ~120 samples
+        next_sample = t0
         cycle = 0
         while time.monotonic() < deadline:
             cycle += 1
+            if time.monotonic() >= next_sample:
+                samples.append((time.monotonic() - t0, rss_mb(), fd_count()))
+                next_sample += sample_every
             # Kill one member abruptly (odd cycles) or leave gracefully.
             victim = extras.pop(0)
             if cycle % 2:
@@ -184,12 +224,43 @@ def test_churn_soak_under_load():
                 lambda: len(a.network) == 1 + len(extras), timeout=20
             ), f"view never converged after join (cycle {cycle})"
         assert cycle >= 3, "soak too short to mean anything"
+        assert not pump_failures, pump_failures
+        samples.append((time.monotonic() - t0, rss_mb(), fd_count()))
+        warm = samples[len(samples) // 3 :]  # drop compile/pool warmup
+        if len(warm) >= 5:
+            ts = np.asarray([s[0] for s in warm])
+            rss = np.asarray([s[1] for s in warm])
+            slope_mb_per_min = float(np.polyfit(ts, rss, 1)[0]) * 60.0
+            fd_delta = warm[-1][2] - warm[0][2]
+            print(
+                f"soak leak curve: {len(samples)} samples over "
+                f"{samples[-1][0]:.0f}s, rss {samples[0][1]:.1f} -> "
+                f"{samples[-1][1]:.1f} MB, post-warmup slope "
+                f"{slope_mb_per_min:.3f} MB/min, fd {samples[0][2]} -> "
+                f"{samples[-1][2]}"
+            )
+            # The slope assertions need a long window: in a sub-10-minute
+            # lane the post-warmup fit spans seconds, where <1 MB of
+            # allocator/GC noise already exceeds any sane threshold.  The
+            # curve prints for every lane; only the DSST_SOAK_SECS
+            # long-haul lane enforces it.
+            if soak_secs >= 600:
+                assert slope_mb_per_min < 1.0, (
+                    f"RSS grows {slope_mb_per_min:.2f} MB/min post-warmup: "
+                    f"{[(round(t), round(r, 1)) for t, r, _ in samples]}"
+                )
+                assert fd_delta <= 8, (
+                    f"fd count drifted by {fd_delta} post-warmup: "
+                    f"{[(round(t), f) for t, _, f in samples]}"
+                )
     finally:
         stop.set()
         pump_t.join(5)
         for j in results:
             assert j.wait(30), "a job was lost in the churn"
             assert j.solved
+        assert not pump_failures, pump_failures
+        assert done_ok[0] + len(results) >= 3, "pump barely ran"
         # Counters on killed members die with them, so the surviving view's
         # totals legitimately undercount; assert shape + liveness only.
         stats = a.stats_view()
