@@ -233,9 +233,14 @@ def solve_bulk(
         # silent downgrade would mislabel A/B measurements.
         raise ValueError("step_impl='fused' is single-chip only (mesh=None)")
     if step_impl is None:
+        # Auto-fused only where it is measured to win (9x9-class boards,
+        # BENCHMARKS.md: 2.2x).  Big geometries force tiny VMEM tiles
+        # (ops/pallas_step.fused_tile) and their wall time lives in the
+        # escalation rungs anyway; explicit step_impl='fused' still works
+        # there (VMEM-sized tiles), it just is not the default.
         step_impl = (
             "fused"
-            if (jax.default_backend() == "tpu" and mesh is None)
+            if (jax.default_backend() == "tpu" and mesh is None and n <= 12)
             else "xla"
         )
     first_cfg = SolverConfig(

@@ -64,6 +64,23 @@ _OUT_ROWS = 8
 _BIG = 2**30
 
 
+def fused_tile(n: int, stack_slots: int) -> int:
+    """Largest power-of-two lane-tile whose working set fits scoped VMEM.
+
+    The kernel's VMEM footprint per lane is roughly (stack_slots + ~8
+    carried full-shape tensors) boards plus fixpoint temporaries; the 4 MB
+    state budget (of the 16 MB scoped limit) is calibrated against
+    measured compiles: 9x9 S=12 fits 128 lanes (16.2 MB total at 256 —
+    over), 16x16 S=64 needs <= 8.  A tile below 8 would thrash the grid,
+    so callers should treat that as "fused not worth it here".
+    """
+    per_lane = (stack_slots + 8) * n * n * 4
+    tile = 8
+    while tile * 2 <= 128 and (tile * 2) * per_lane <= 4 << 20:
+        tile *= 2
+    return tile
+
+
 def _bcast_reduce(x: jax.Array, axis: int, comb) -> jax.Array:
     """Reduce ``axis`` to 1, then *materialize* the replication back to the
     input shape with ``_expand`` (a concat of slice copies).
@@ -521,9 +538,10 @@ def _fused_round(fs: FusedFrontier, geom: Geometry, config) -> FusedFrontier:
         branch_rule=config.branch,
         max_sweeps=config.max_sweeps,
         k_steps=config.fused_steps,
-        # 128-lane tiles: the full-shape carries + fixpoint temporaries of a
-        # 256-lane tile overflow the 16 MB scoped-VMEM budget at 9x9.
-        tile=min(128, n_lanes),
+        # VMEM-sized tiles (128 at 9x9/S=12; smaller for big boards or deep
+        # stacks — a 256-lane 9x9 tile already overflowed the 16 MB scoped
+        # budget).
+        tile=min(fused_tile(geom.n, config.stack_slots), n_lanes),
     )
 
     # First-lane-wins harvest per job (the composite step's exact rule).
@@ -596,15 +614,16 @@ def solve_batch_fused(
         _decode_solution,
     )
 
-    # Round the lane count up to a multiple of the kernel tile (128) so the
+    # Round the lane count up to a multiple of the kernel tile so the
     # grid divides evenly — the composite path has no such constraint, and
     # a raise on e.g. 200 lanes would leak a kernel implementation detail.
     # Extra lanes start idle and join as thieves, exactly like min_lanes
     # slack.
     n_jobs = grids.shape[0]
     lanes = config.resolve_lanes(n_jobs)
-    if lanes > 128:
-        lanes = -(-lanes // 128) * 128
+    tile = fused_tile(geom.n, config.stack_slots)
+    if lanes > tile:
+        lanes = -(-lanes // tile) * tile
     config = dataclasses.replace(config, lanes=lanes)
 
     state = init_frontier(encode_grid(grids, geom), config)
