@@ -234,13 +234,18 @@ def solve_bulk(
         raise ValueError("step_impl='fused' is single-chip only (mesh=None)")
     if step_impl is None:
         # Auto-fused only where it is measured to win (9x9-class boards,
-        # BENCHMARKS.md: 2.2x).  Big geometries force tiny VMEM tiles
-        # (ops/pallas_step.fused_tile) and their wall time lives in the
-        # escalation rungs anyway; explicit step_impl='fused' still works
-        # there (VMEM-sized tiles), it just is not the default.
+        # BENCHMARKS.md: 2.2x) AND the (n, stack_slots) working set fits
+        # VMEM at the mandatory 128-lane tile (ops/pallas_step.fused_tile).
+        from distributed_sudoku_solver_tpu.ops.pallas_step import fused_tile
+
         step_impl = (
             "fused"
-            if (jax.default_backend() == "tpu" and mesh is None and n <= 12)
+            if (
+                jax.default_backend() == "tpu"
+                and mesh is None
+                and n <= 12
+                and fused_tile(n, config.stack_slots) > 0
+            )
             else "xla"
         )
     first_cfg = SolverConfig(

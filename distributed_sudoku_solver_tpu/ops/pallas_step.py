@@ -65,20 +65,19 @@ _BIG = 2**30
 
 
 def fused_tile(n: int, stack_slots: int) -> int:
-    """Largest power-of-two lane-tile whose working set fits scoped VMEM.
+    """128 if a 128-lane tile's working set fits scoped VMEM, else 0.
 
-    The kernel's VMEM footprint per lane is roughly (stack_slots + ~8
-    carried full-shape tensors) boards plus fixpoint temporaries; the 4 MB
-    state budget (of the 16 MB scoped limit) is calibrated against
-    measured compiles: 9x9 S=12 fits 128 lanes (16.2 MB total at 256 —
-    over), 16x16 S=64 needs <= 8.  A tile below 8 would thrash the grid,
-    so callers should treat that as "fused not worth it here".
+    Mosaic requires the block's lane dimension to be a multiple of 128 (or
+    equal to the whole array), so 128 is the ONLY viable tile width once
+    lanes exceed 128 — there is no "shrink the tile" escape hatch.  The
+    4 MB carried-state budget (of the 16 MB scoped limit; fixpoint
+    temporaries take the rest) is calibrated against measured compiles:
+    9x9 S=12 fits at 128 (256 overflows by 218 KB), 16x16 S=64 needs
+    33.5 MB at 256.  0 means the fused path cannot run at this
+    (n, stack_slots) beyond 128 lanes.
     """
     per_lane = (stack_slots + 8) * n * n * 4
-    tile = 8
-    while tile * 2 <= 128 and (tile * 2) * per_lane <= 4 << 20:
-        tile *= 2
-    return tile
+    return 128 if 128 * per_lane <= (4 << 20) else 0
 
 
 def _bcast_reduce(x: jax.Array, axis: int, comb) -> jax.Array:
@@ -538,10 +537,9 @@ def _fused_round(fs: FusedFrontier, geom: Geometry, config) -> FusedFrontier:
         branch_rule=config.branch,
         max_sweeps=config.max_sweeps,
         k_steps=config.fused_steps,
-        # VMEM-sized tiles (128 at 9x9/S=12; smaller for big boards or deep
-        # stacks — a 256-lane 9x9 tile already overflowed the 16 MB scoped
-        # budget).
-        tile=min(fused_tile(geom.n, config.stack_slots), n_lanes),
+        # Lanes were validated/rounded by solve_batch_fused: <= 128 lanes
+        # use one full-array tile, beyond that always 128-lane tiles.
+        tile=min(128, n_lanes),
     )
 
     # First-lane-wins harvest per job (the composite step's exact rule).
@@ -621,9 +619,15 @@ def solve_batch_fused(
     # slack.
     n_jobs = grids.shape[0]
     lanes = config.resolve_lanes(n_jobs)
-    tile = fused_tile(geom.n, config.stack_slots)
-    if lanes > tile:
-        lanes = -(-lanes // tile) * tile
+    if lanes > 128:
+        if fused_tile(geom.n, config.stack_slots) == 0:
+            raise ValueError(
+                f"step_impl='fused' would overflow scoped VMEM at "
+                f"n={geom.n}, stack_slots={config.stack_slots} beyond 128 "
+                f"lanes (see fused_tile); use step_impl='xla' or a "
+                f"shallower stack"
+            )
+        lanes = -(-lanes // 128) * 128
     config = dataclasses.replace(config, lanes=lanes)
 
     state = init_frontier(encode_grid(grids, geom), config)
