@@ -161,3 +161,20 @@ def test_count_all_overflow_is_lower_bound():
     res = solve_csp(_roots(p), p, cfg)
     assert bool(res.overflowed[0])
     assert int(res.sol_count[0]) <= 92
+
+
+def test_count_all_sharded_exact():
+    """Enumeration under the 8-device lane-sharded path: per-chip counts
+    psum-merge to the exact global model count."""
+    import jax
+
+    from distributed_sudoku_solver_tpu.parallel import make_mesh, solve_csp_sharded
+
+    p = nqueens_cover(8)
+    cfg = SolverConfig(
+        min_lanes=64, stack_slots=128, max_steps=100_000, count_all=True
+    )
+    res = solve_csp_sharded(_roots(p), p, cfg, mesh=make_mesh(jax.devices()))
+    assert int(np.asarray(res.sol_count[0])) == 92
+    assert bool(np.asarray(res.unsat[0]))
+    assert not bool(np.asarray(res.overflowed[0]))
