@@ -708,7 +708,14 @@ class ClusterNode:
         # Clock starts before the (blocking, wire-bound) submissions so the
         # caller's timeout bounds the whole race, not just the wait.
         start = time.monotonic()
-        jobs = [self.submit(grid, config=cfg) for cfg in configs]
+        jobs = []
+        try:
+            for cfg in configs:
+                jobs.append(self.submit(grid, config=cfg))
+        except BaseException:
+            for j in jobs:  # don't strand racers already placed on members
+                self.cancel(j.uuid)
+            raise
         res = race_jobs(jobs, cancel=self.cancel, timeout=timeout, start=start)
         if res.winner is not None:
             res.strategy = configs[res.winner_index].branch

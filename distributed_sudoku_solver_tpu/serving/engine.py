@@ -141,6 +141,13 @@ class SolverEngine:
         max_flights: int = 4,
         handicap_s: float = 0.0,
     ):
+        if solve_fn is None and config.step_impl != "xla":
+            # Same rule as _enqueue, for the engine-wide default config: a
+            # 'fused' default would silently run flights as 'xla'.
+            raise ValueError(
+                f"engine flights support step_impl='xla' only, got "
+                f"{config.step_impl!r}"
+            )
         self.config = config
         self.max_batch = max_batch
         self.batch_window_s = batch_window_s
@@ -207,6 +214,16 @@ class SolverEngine:
         return job
 
     def _enqueue(self, job: Job) -> None:
+        if job.config is not None and job.config.step_impl != "xla":
+            # Flights advance via the composite checkpoint path; silently
+            # running a 'fused' config as 'xla' would mislabel portfolio
+            # racers and A/B measurements (the branch_k precedent).  The
+            # fused kernel serves the batch entry points (ops/bulk,
+            # solve_batch); engine integration is future work.
+            raise ValueError(
+                f"engine flights support step_impl='xla' only, got "
+                f"{job.config.step_impl!r}"
+            )
         # Lock-ordered with stop()'s final drain: either this put happens
         # before the drain (and is swept by it), or _stop is already
         # visible here and we fail fast instead of stranding the caller.

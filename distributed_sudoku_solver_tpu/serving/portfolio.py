@@ -109,9 +109,16 @@ def race(
     if not configs:
         raise ValueError("portfolio needs at least one config")
     start = time.monotonic()
-    jobs = [
-        engine.submit(grid, geom=geom, config=cfg, job_uuid=None) for cfg in configs
-    ]
+    jobs = []
+    try:
+        for cfg in configs:
+            jobs.append(engine.submit(grid, geom=geom, config=cfg, job_uuid=None))
+    except BaseException:
+        # A mid-list rejection (e.g. a config the engine refuses) must not
+        # strand the already-submitted racers searching with no waiter.
+        for j in jobs:
+            engine.cancel(j.uuid)
+        raise
     res = race_jobs(jobs, cancel=engine.cancel, timeout=timeout, start=start)
     if res.winner is not None:
         res.strategy = configs[res.winner_index].branch

@@ -300,3 +300,11 @@ def test_shed_work_marks_exhaustion_unreliable():
             assert is_valid_solution(j.solution)
     finally:
         eng.stop(timeout=2)
+
+
+def test_engine_rejects_fused_config(engine):
+    """Engine flights run the composite step; a 'fused' per-job config must
+    fail loudly instead of silently running as 'xla' (which would mislabel
+    portfolio racers and A/B measurements)."""
+    with pytest.raises(ValueError, match="step_impl"):
+        engine.submit(EASY_9, config=SolverConfig(min_lanes=4, step_impl="fused"))
