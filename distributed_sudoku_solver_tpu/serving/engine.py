@@ -70,6 +70,9 @@ class Job:
     solved: bool = False
     unsat: bool = False
     nodes: int = 0
+    sol_count: int = 0  # solutions found (exact model count under a
+    #   config with count_all=True, where `unsat` means "enumeration
+    #   complete" and `solution` holds the first one found)
     cancelled: bool = False
     # Mid-job offload bookkeeping: rows shed to a peer leave the local search
     # space incomplete, so "local space exhausted" (`exhausted`) is no longer
@@ -562,6 +565,7 @@ class SolverEngine:
         unsat = np.asarray(res.unsat)
         nodes = np.asarray(res.nodes)
         solved = np.asarray(res.solved)
+        sol_counts = np.asarray(res.sol_count)
         for i, job in enumerate(fl.jobs):
             if job.done.is_set():
                 continue
@@ -569,7 +573,10 @@ class SolverEngine:
             job.exhausted = bool(unsat[i])
             job.unsat = job.exhausted and job.shed_parts == 0
             job.nodes = int(nodes[i])
-            if job.solved:
+            job.sol_count = int(sol_counts[i])
+            if job.solved or job.sol_count > 0:
+                # count_all enumerations keep `solved` False by design but
+                # still carry the first-found solution.
                 job.solution = solutions[i]
             if self._consume_cancel(job):
                 job.cancelled = True
@@ -649,6 +656,11 @@ class SolverEngine:
         # of the small [L] vectors); shedding is rare, one sync is fine.
         best = None  # (stack_rows, flight, job index)
         for fl in self._flights:
+            if fl.config.count_all:
+                # An enumeration's shed rows would be counted by the PEER
+                # and aggregated nowhere — the returned model count would
+                # silently miss those subtrees.  Enumerations never shed.
+                continue
             jobv = np.asarray(fl.state.job)
             countv = np.asarray(fl.state.count)
             solvedv = np.asarray(fl.state.solved)
@@ -710,12 +722,16 @@ class SolverEngine:
         solutions = np.asarray(res.solution)
         nodes = np.asarray(res.nodes)
 
+        # Optional field: oracle-backed test solve_fns don't produce it.
+        sol_counts = np.asarray(getattr(res, "sol_count", solved.astype(np.int32)))
+
         now = time.monotonic()
         for i, job in enumerate(group):
             job.solved = bool(solved[i])
             job.unsat = bool(unsat[i])
             job.nodes = int(nodes[i])
-            if job.solved:
+            job.sol_count = int(sol_counts[i])
+            if job.solved or job.sol_count > 0:
                 job.solution = solutions[i]
             if self._consume_cancel(job):
                 job.cancelled = True

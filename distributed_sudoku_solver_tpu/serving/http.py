@@ -11,6 +11,10 @@ DHT_Node.py:540-614`` (SudokuHandler):
 Superset endpoints (absent from the reference):
 
 * ``GET /metrics`` — latency percentiles, batch sizes, device info.
+* ``POST /solve`` with ``"count_all": true`` — enumerate EVERY solution
+  to exhaustion and return the exact model count plus the first solution
+  found (the reference's DFS stops at one solution and cannot express
+  this).
 * ``POST /solve`` with ``"portfolio": true`` — race the default strategy
   portfolio (``serving/portfolio.DEFAULT_PORTFOLIO``) on the board; the
   first verdict wins and cancels the losers (on a cluster node the racers
@@ -80,6 +84,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
         start = time.time()
         timeout = self.server.solve_timeout_s
+        if payload.get("count_all"):
+            return self._solve_count_all(node, g, start, timeout)
         strategy = None
         if payload.get("portfolio"):
             try:
@@ -119,6 +125,40 @@ class _Handler(BaseHTTPRequestHandler):
             500,
             {"error": job.error or "search budget exhausted", "duration": duration},
         )
+
+    def _solve_count_all(self, node, grid, start, timeout):
+        """``POST /solve`` with ``"count_all": true``: enumerate EVERY
+        solution (``SolverConfig.count_all``); 200 with the exact model
+        count, the first solution found (null if none), and whether the
+        enumeration ran to completion.  A capability the reference cannot
+        express at all — its search stops at the first solution
+        (``/root/reference/DHT_Node.py:474-538``)."""
+        import dataclasses
+        import time
+
+        engine = getattr(node, "engine", None)
+        if engine is None:
+            return self._send(500, {"error": "node has no engine"})
+        try:
+            job = engine.submit(
+                grid, config=dataclasses.replace(engine.config, count_all=True)
+            )
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        if not job.wait(timeout):
+            engine.cancel(job.uuid)
+            return self._send(504, {"error": "enumeration timed out"})
+        if job.error:
+            return self._send(500, {"error": job.error})
+        body = {
+            "count": int(job.sol_count),
+            # unsat == search space exhausted == the count is complete
+            # (unless a stack overflow dropped subtrees: then lower bound).
+            "complete": bool(job.unsat and not job.cancelled),
+            "solution": job.solution.tolist() if job.sol_count > 0 else None,
+            "duration": time.time() - start,
+        }
+        return self._send(200, body)
 
     @staticmethod
     def _race(node, grid, timeout):

@@ -227,3 +227,17 @@ def test_stat_window_percentiles():
     snap = w.snapshot()
     assert snap["total"] == 100 and snap["count"] == 8
     assert 93 <= snap["p50"] <= 100
+
+
+def test_solve_count_all_endpoint(server):
+    """POST /solve with count_all=true enumerates every solution: the empty
+    4x4 board has exactly 288 completions (a capability the reference's
+    first-solution DFS cannot express)."""
+    code, body = _request(server, "/solve", {
+        "sudoku": [[0] * 4 for _ in range(4)],
+        "count_all": True,
+    })
+    assert code == 200
+    assert body["count"] == 288
+    assert body["complete"] is True
+    assert body["solution"] is not None
