@@ -4,12 +4,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Protocol: the full bulk pipeline (``ops/bulk.py``: one-dispatch frontier
 chunks — propagation, search, gang-up and cancellation all in-graph) over a
-corpus of 65,536 boards — 2,048 distinct generated 24-clue puzzles (harder
-than typical 17-clue sets: ~45% resist propagation alone) plus the three
-famous hard benchmark boards, tiled 32x (round 1 tiled the same corpus 16x;
-the distribution is identical, the width now matches the 65,536-lane
-chunk that one dispatch solves).  The timed run is the *second* full pass
-(steady-state; compiles and host caches warm).
+corpus of 65,536 FULLY DISTINCT boards — 65,533 generated 24-clue puzzles
+(harder than typical 17-clue sets: ~45% resist propagation alone) plus the
+three famous hard benchmark boards (rounds 1-3 tiled a 2,048-board corpus
+16-32x; round 4 retired the tiling asterisk — measured deltas vs the tiled
+corpus are in BENCHMARKS.md).  Generation is cached on disk
+(``benchmarks/pregen_corpus.py`` pre-fills it in ~4 min parallel; a cold
+cache regenerates inline, ~35 min single-threaded).  The timed run is the
+*second* full pass (steady-state; compiles and host caches warm).
 
 Timing forces a host-side value fetch per pass (``np.asarray``) —
 ``block_until_ready`` does not reliably block through the axon RPC tunnel
@@ -48,9 +50,8 @@ def main() -> None:
     from distributed_sudoku_solver_tpu.ops.solve import solve_batch
     from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, puzzle_batch
 
-    distinct = puzzle_batch(SUDOKU_9, 2048 - len(HARD_9), seed=7, n_clues=24)
-    corpus = np.concatenate([np.stack(HARD_9), distinct]).astype(np.int32)
-    grids = np.tile(corpus, (32, 1, 1))  # 65,536 boards
+    distinct = puzzle_batch(SUDOKU_9, 65536 - len(HARD_9), seed=7, n_clues=24)
+    grids = np.concatenate([np.stack(HARD_9), distinct]).astype(np.int32)
     b = grids.shape[0]
 
     cfg = BulkConfig()  # extended rules, 65,536-lane one-dispatch chunks

@@ -1,0 +1,112 @@
+"""Pre-generate distinct-board bench corpora into the puzzle cache.
+
+VERDICT r3 #9 (retire the tiling asterisk): the headline bench corpus
+becomes 65,536 fully distinct generated puzzles, and the 1M-board
+solve-file row gets a fully distinct corpus too.  Generation is ~34 ms per
+puzzle single-threaded (dozens of native uniqueness probes per carve,
+``utils/puzzles.make_puzzle``), so this script parallelizes across
+processes and writes results where the normal cache lookups find them:
+
+* the headline batch lands in the ``puzzle_batch`` on-disk cache under the
+  EXACT key that ``bench.py``'s call computes — the bench itself then
+  loads it in milliseconds and never generates;
+* the 1M solve-file corpus lands as a text file of board lines
+  (``utils/dataset`` format), one distinct puzzle per line.
+
+Deterministic: worker i carves seed ``seed + i``, identical to the
+sequential ``puzzle_batch`` loop, so the cache it fills is bit-identical
+to what an (impractically slow) inline generation would produce.
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python benchmarks/pregen_corpus.py` from anywhere
+    sys.path.insert(0, REPO)
+
+
+def _one(args) -> np.ndarray:
+    seed, n_clues = args
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
+
+    return make_puzzle(SUDOKU_9, seed, n_clues=n_clues)
+
+
+def _carve(pool, count: int, seed: int, n_clues: int, label: str):
+    t0 = time.perf_counter()
+    out = []
+    for i, board in enumerate(
+        pool.imap(_one, ((seed + j, n_clues) for j in range(count)), chunksize=64)
+    ):
+        out.append(board)
+        if (i + 1) % 8192 == 0:
+            rate = (i + 1) / (time.perf_counter() - t0)
+            print(
+                f"[{label}] {i + 1}/{count} ({rate:.0f}/s, "
+                f"eta {(count - i - 1) / rate / 60:.1f} min)",
+                flush=True,
+            )
+    return np.stack(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--headline", type=int, default=65536 - 3)
+    ap.add_argument("--solvefile", type=int, default=0)  # e.g. 1_000_000
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--n-clues", type=int, default=24)
+    ap.add_argument("--workers", type=int, default=min(16, os.cpu_count() or 1))
+    args = ap.parse_args()
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.utils import puzzles
+
+    cache = os.environ.get("DSST_PUZZLE_CACHE") or os.path.join(
+        REPO, ".cache", "puzzles"
+    )
+    os.makedirs(cache, exist_ok=True)
+
+    with mp.Pool(args.workers) as pool:
+        if args.headline:
+            geom = SUDOKU_9
+            key = (
+                f"v{puzzles._GENERATOR_VERSION}_{geom.box_h}x{geom.box_w}"
+                f"_{args.headline}_{args.seed}_{args.n_clues}_1"
+            )
+            path = os.path.join(cache, f"puzzles_{key}.npy")
+            if os.path.exists(path):
+                print(f"[headline] already cached: {path}")
+            else:
+                batch = _carve(pool, args.headline, args.seed, args.n_clues, "headline")
+                tmp = f"{path}.{os.getpid()}.tmp.npy"
+                np.save(tmp, batch)
+                os.replace(tmp, path)
+                print(f"[headline] wrote {path}")
+
+        if args.solvefile:
+            # Non-overlapping seed range so the two corpora stay disjoint.
+            sf_seed = args.seed + 1_000_000
+            path = os.path.join(cache, f"solvefile_{args.solvefile}_{sf_seed}.txt")
+            if os.path.exists(path):
+                print(f"[solvefile] already cached: {path}")
+            else:
+                batch = _carve(pool, args.solvefile, sf_seed, args.n_clues, "solvefile")
+                tmp = f"{path}.{os.getpid()}.tmp"
+                with open(tmp, "w") as f:
+                    for board in batch:
+                        f.write(puzzles.to_line(board) + "\n")
+                os.replace(tmp, path)
+                print(f"[solvefile] wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -226,6 +226,11 @@ class _Exec:
             if info is None or info["done"]:
                 return
             info["done"] = True
+            # Drop the retained recovery rows: the part is done, nobody will
+            # re-enter it (take_orphaned skips rows-None entries), and a
+            # long-running job that sheds many parts must not hold every
+            # part's packed stack rows until finalize (ADVICE r3).
+            info["rows"] = None
             info["exhausted"] = bool(msg.get("unsat"))
             info["nodes"] = int(msg.get("nodes", 0))
             peer, rehomed = info["peer"], info["rehomed"]

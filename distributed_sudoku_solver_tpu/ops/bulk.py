@@ -228,21 +228,18 @@ def solve_bulk(
     chunk = min(config.chunk, max(64, 1 << (max(b, 1) - 1).bit_length()))
     chunk = max(n_dev, -(-chunk // n_dev) * n_dev)
     step_impl = config.step_impl
-    if step_impl == "fused" and mesh is not None:
-        # The sharded driver runs the composite step inside shard_map; a
-        # silent downgrade would mislabel A/B measurements.
-        raise ValueError("step_impl='fused' is single-chip only (mesh=None)")
     if step_impl is None:
         # Auto-fused only where it is measured to win (9x9-class boards,
         # BENCHMARKS.md: 2.2x) AND the (n, stack_slots) working set fits
         # VMEM at the mandatory 128-lane tile (ops/pallas_step.fused_tile).
+        # Meshes qualify since round 4: the sharded driver dispatches to
+        # parallel/fused_sharded (per-chip fused rounds + ring collectives).
         from distributed_sudoku_solver_tpu.ops.pallas_step import fused_tile
 
         step_impl = (
             "fused"
             if (
                 jax.default_backend() == "tpu"
-                and mesh is None
                 and n <= 12
                 and fused_tile(n, config.stack_slots) > 0
             )

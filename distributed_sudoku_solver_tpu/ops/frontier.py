@@ -88,7 +88,8 @@ class SolverConfig:
     step_impl: str = "xla"  # 'xla' (composite step, bit-exactness contract)
     #   | 'fused' (whole-round VMEM Pallas kernel, ops/pallas_step.py:
     #   k-step dispatches, purge/steal at that granularity — sound, not
-    #   bit-exact to 'xla'; batch solves only)
+    #   bit-exact to 'xla'; serves batch solves AND engine flights via
+    #   advance_frontier_fused; single-chip and lane-sharded meshes)
     fused_steps: int = 8  # frontier rounds per fused-kernel dispatch
     steal: bool = True  # receiver-initiated work stealing between lanes
     steal_rounds: int = 1  # pairings per step; >1 ramps idle gangs up faster
@@ -118,6 +119,16 @@ class SolverConfig:
         if lanes < n_jobs:
             raise ValueError(f"lanes={lanes} < n_jobs={n_jobs}")
         return lanes
+
+    def resolve_lanes_packed(self, n_roots: int) -> int:
+        """Lane count :func:`init_frontier_packed` will use for ``n_roots``
+        round-robin-dealt rows — the single source of truth for callers
+        (the engine's fused-width validation) that must predict it."""
+        if self.lanes > 0:
+            return self.lanes
+        import math
+
+        return max(self.min_lanes, math.ceil(n_roots / (1 + self.stack_slots)))
 
 
 class Frontier(NamedTuple):
@@ -267,14 +278,9 @@ def init_frontier_packed(
     """
     n_roots, h, w = roots.shape
     s = config.stack_slots
-    import math
-
     import numpy as np
 
-    if config.lanes > 0:
-        n_lanes = config.lanes
-    else:
-        n_lanes = max(config.min_lanes, math.ceil(n_roots / (1 + s)))
+    n_lanes = config.resolve_lanes_packed(n_roots)
     if n_roots > n_lanes * (1 + s):
         raise ValueError(
             f"{n_roots} roots exceed frontier capacity {n_lanes}x(1+{s})"

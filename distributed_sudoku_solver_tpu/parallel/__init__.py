@@ -15,3 +15,6 @@ from distributed_sudoku_solver_tpu.parallel.sharded import (  # noqa: F401
     solve_batch_sharded,
     solve_csp_sharded,
 )
+from distributed_sudoku_solver_tpu.parallel.fused_sharded import (  # noqa: F401
+    solve_batch_fused_sharded,
+)

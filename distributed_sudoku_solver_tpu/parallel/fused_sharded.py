@@ -1,0 +1,263 @@
+"""Lane-sharded fused solve: the whole-round VMEM kernel under `shard_map`.
+
+VERDICT r3 #2: the fused kernel's own driver already splits the work into
+a Mosaic half (the in-VMEM rounds) and an XLA half (job harvest, purge,
+steal — ``ops/pallas_step._fused_round``).  The XLA half is exactly the
+shard-friendly part, so the multi-chip composition mirrors
+``parallel/sharded.py`` one-to-one:
+
+* **per chip, per round**: one ``pallas_call`` advances the chip's local
+  lane tile block ``fused_steps`` rounds in VMEM, then the local XLA glue
+  harvests/purges/steals — all on shard-local shapes;
+* **SOLUTION_FOUND broadcast**: newly-solved flags OR-merge across chips
+  with a ``psum``, winner chosen by ``pmin`` over chip index (lowest
+  global lane, the composite rule — chips own contiguous lane blocks);
+* **NEEDWORK/TASK**: the same receiver-initiated ring ``ppermute`` as the
+  composite path, re-expressed on boards-last tensors
+  (:func:`_ring_steal_t`);
+* **step lockstep**: per-chip ``steps`` advance by the max in-kernel
+  rounds across *local* tiles, which diverges across chips — the round
+  ``pmax``es steps so the outer ``while_loop`` condition stays replicated
+  (an SPMD loop whose trip counts diverge would deadlock its collectives).
+  The budget approximation documented on ``solve_batch_fused`` (max
+  across tiles) therefore extends to max across chips.
+
+Reference bar: the reference's one kernel ran on every ring node
+simultaneously (``/root/reference/DHT_Node.py:491-510``); this module is
+that — the fused kernel on every chip with the ring around it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    _lane_by_rank,
+    init_frontier,
+)
+from distributed_sudoku_solver_tpu.ops.pallas_step import (
+    FusedFrontier,
+    _fused_live,
+    _fused_round,
+    frontier_to_fused,
+    fused_lanes,
+)
+from distributed_sudoku_solver_tpu.ops.solve import SolveResult, _decode_solution
+
+
+def _ring_steal_t(
+    top_t: jax.Array,
+    has_top: jax.Array,
+    stack_t: jax.Array,
+    base: jax.Array,
+    count: jax.Array,
+    job: jax.Array,
+    job_live: jax.Array,
+    axis: str,
+    k: int,
+):
+    """``parallel/sharded._ring_steal`` on boards-last tensors (lanes LAST).
+
+    Same protocol: the successor advertises its idle-lane count backwards,
+    the donor pops up to ``min(request, donors, k)`` bottom stack rows and
+    ships them forward, the receiver installs them into idle lanes' tops.
+    Work-conserving by construction (the donor removes exactly what it
+    ships; the receiver's idle count cannot have shrunk — the local steal
+    already ran this round and nothing else touches it).
+    """
+    n_dev = jax.lax.axis_size(axis)
+    n_lanes = has_top.shape[0]
+    s = stack_t.shape[0]
+    k = min(k, n_lanes)
+    slot_k = jnp.arange(k, dtype=jnp.int32)
+
+    fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]  # donor -> successor
+    back = [(i, (i - 1) % n_dev) for i in range(n_dev)]  # request travels back
+
+    idle = ~has_top
+    n_idle = jnp.sum(idle).astype(jnp.int32)
+    request = jax.lax.ppermute(n_idle, axis, back)  # my successor's idle count
+
+    donor = has_top & (count >= 1) & job_live
+    donor_of = _lane_by_rank(donor, n_lanes)
+    n_send = jnp.minimum(jnp.minimum(request, jnp.sum(donor)), k).astype(jnp.int32)
+    take = slot_k < n_send
+    donor_lane = jnp.where(take, donor_of[:k], n_lanes)
+    safe_donor = jnp.clip(donor_lane, 0, n_lanes - 1)
+
+    bottom = jnp.take_along_axis(
+        stack_t, (base % s)[None, None, None, :], axis=0
+    )[0]  # [n, n, L]: each lane's bottom stack row
+    boards = jnp.where(
+        take[None, None, :], bottom[:, :, safe_donor], jnp.uint32(0)
+    )  # [n, n, k]
+    jobs_out = jnp.where(take, job[safe_donor], jnp.int32(-1))
+
+    donor_sel = jnp.zeros(n_lanes, bool).at[donor_lane].set(take, mode="drop")
+    base = jnp.where(donor_sel, (base + 1) % s, base)
+    count = jnp.where(donor_sel, count - 1, count)
+
+    boards_in = jax.lax.ppermute(boards, axis, fwd)
+    jobs_in = jax.lax.ppermute(jobs_out, axis, fwd)
+    n_in = jax.lax.ppermute(n_send, axis, fwd)
+
+    install = slot_k < n_in
+    thief_of = _lane_by_rank(idle, n_lanes)
+    thief_lane = jnp.where(install, thief_of[:k], n_lanes)
+    top_t = top_t.at[:, :, thief_lane].set(boards_in, mode="drop")
+    has_top = has_top.at[thief_lane].set(install, mode="drop")
+    job = job.at[thief_lane].set(jobs_in, mode="drop")
+    return top_t, has_top, base, count, job, n_in
+
+
+def _fused_round_sharded(
+    fs: FusedFrontier, geom: Geometry, config: SolverConfig, axis: str
+) -> FusedFrontier:
+    """One fused dispatch + local bookkeeping, then the cross-chip merges."""
+    n_jobs = fs.solved.shape[0]
+    n_dev = jax.lax.axis_size(axis)
+    prev_solved = fs.solved
+    prev_solution_t = fs.solution_t
+
+    fs = _fused_round(fs, geom, config)  # kernel + local harvest/purge/steal
+
+    # --- merge job resolution across chips (the SOLUTION_FOUND broadcast) ---
+    newly = fs.solved & ~prev_solved
+    newly_any = jax.lax.psum(newly.astype(jnp.int32), axis) > 0
+    dev = jax.lax.axis_index(axis).astype(jnp.int32)
+    key = jnp.where(newly, dev, jnp.int32(n_dev))
+    winner = jax.lax.pmin(key, axis)
+    contrib = jnp.where(
+        (newly & (key == winner))[None, None, :], fs.solution_t, jnp.uint32(0)
+    )
+    solution_t = jnp.where(
+        newly_any[None, None, :], jax.lax.psum(contrib, axis), prev_solution_t
+    )
+    solved = prev_solved | newly_any
+    overflowed = jax.lax.psum(fs.overflowed.astype(jnp.int32), axis) > 0
+
+    # --- purge lanes of globally-resolved jobs, then the ICI ring steal -----
+    job_safe = jnp.clip(fs.job, 0, n_jobs - 1)
+    job_live = (fs.job >= 0) & ~solved[job_safe]
+    has_top = fs.has_top & job_live
+    count = jnp.where(job_live, fs.count, 0)
+    top_t, base, job, steals = fs.top_t, fs.base, fs.job, fs.steals
+    if n_dev > 1 and config.steal and config.ring_steal_k > 0:
+        top_t, has_top, base, count, job, shipped = _ring_steal_t(
+            top_t, has_top, fs.stack_t, base, count, job, job_live,
+            axis, config.ring_steal_k,
+        )
+        steals = steals + shipped
+
+    return fs._replace(
+        top_t=top_t,
+        has_top=has_top,
+        base=base,
+        count=count,
+        job=job,
+        solved=solved,
+        solution_t=solution_t,
+        overflowed=overflowed,
+        sol_count=solved.astype(jnp.int32),
+        # Replicate the step counter: per-chip deltas are the max in-kernel
+        # rounds across local tiles and diverge chip-to-chip; a diverged
+        # while-loop trip count would deadlock the collectives above.
+        steps=jax.lax.pmax(fs.steps, axis),
+        steals=steals,
+    )
+
+
+def _run_fused_sharded(
+    state: Frontier, geom: Geometry, config: SolverConfig, axis: str
+) -> SolveResult:
+    """Per-chip body: boards-last conversion, the solve loop, finalize psums."""
+    fs = frontier_to_fused(state)
+
+    def cond(f: FusedFrontier):
+        local_live = jnp.any(_fused_live(f)).astype(jnp.int32)
+        return (jax.lax.psum(local_live, axis) > 0) & (
+            f.steps < config.max_steps
+        )
+
+    fs = jax.lax.while_loop(
+        cond, lambda f: _fused_round_sharded(f, geom, config, axis), fs
+    )
+
+    n_jobs = fs.solved.shape[0]
+    job_safe = jnp.clip(fs.job, 0, n_jobs - 1)
+    has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(
+        _fused_live(fs), mode="drop"
+    )
+    has_work = jax.lax.psum(has_work.astype(jnp.int32), axis) > 0
+    unsat = ~fs.solved & ~has_work & ~fs.overflowed
+    return SolveResult(
+        solution=fs.solution_t.transpose(2, 0, 1),  # replicated post-merge
+        solved=fs.solved,
+        unsat=unsat,
+        overflowed=fs.overflowed,
+        nodes=jax.lax.psum(fs.nodes, axis),
+        sol_count=fs.sol_count,  # replicated (== solved); never psummed
+        steps=fs.steps,
+        sweeps=jax.lax.psum(fs.sweeps, axis),
+        expansions=jax.lax.psum(fs.expansions, axis),
+        steals=jax.lax.psum(fs.steals, axis),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config", "mesh"))
+def _solve_fused_sharded_jit(
+    grids: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
+) -> SolveResult:
+    n_jobs = grids.shape[0]
+    (axis,) = mesh.axis_names
+    n_dev = mesh.devices.size
+
+    # Each chip's lane block must itself be a kernel-valid width (<= 128, or
+    # a multiple of 128) — size per-chip first, then scale by the mesh.
+    per_chip = -(-config.resolve_lanes(n_jobs) // n_dev)
+    per_chip = fused_lanes(per_chip, geom.n, config.stack_slots)
+    cfg = dataclasses.replace(config, lanes=per_chip * n_dev)
+
+    state = init_frontier(encode_grid(grids, geom), cfg)
+
+    lane = lambda: P(axis)  # noqa: E731
+    lane_specs = Frontier(
+        top=lane(), has_top=lane(), stack=lane(), base=lane(), count=lane(),
+        job=lane(),
+        solved=P(), solution=P(), overflowed=P(), nodes=P(), sol_count=P(),
+        steps=P(), sweeps=P(), expansions=P(), steals=P(),
+    )
+    out_specs = SolveResult(
+        solution=P(), solved=P(), unsat=P(), overflowed=P(), nodes=P(),
+        sol_count=P(), steps=P(), sweeps=P(), expansions=P(), steals=P(),
+    )
+    body = jax.shard_map(
+        functools.partial(_run_fused_sharded, geom=geom, config=cfg, axis=axis),
+        mesh=mesh,
+        in_specs=(lane_specs,),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return _decode_solution(body(state))
+
+
+def solve_batch_fused_sharded(
+    grids,
+    geom: Geometry,
+    config: SolverConfig = SolverConfig(step_impl="fused"),
+    mesh: Mesh | None = None,
+) -> SolveResult:
+    """Fused-step solve of int grids [J, n, n], lanes sharded over ``mesh``."""
+    from distributed_sudoku_solver_tpu.parallel.mesh import default_mesh
+
+    mesh = mesh if mesh is not None else default_mesh()
+    return _solve_fused_sharded_jit(jnp.asarray(grids), geom, config, mesh)

@@ -199,13 +199,21 @@ def _run_sharded(
     has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(live_local, mode="drop")
     has_work = jax.lax.psum(has_work.astype(jnp.int32), axis) > 0
     unsat = ~state.solved & ~has_work & ~state.overflowed
+    # Find-one mode: two chips can each resolve the same job in the same
+    # round (the solved-psum merge lands after both local harvests), so the
+    # psummed per-chip sol_counts can read 2 — clamp to the documented
+    # "0 or 1 normally" contract (ops/solve.py).  Enumeration counts are
+    # disjoint-subtree sums and add exactly.
+    sol_count = jax.lax.psum(res.sol_count, axis)
+    if not config.count_all:
+        sol_count = jnp.minimum(sol_count, 1)
     return SolveResult(
         solution=res.solution,
         solved=res.solved,
         unsat=unsat,
         overflowed=res.overflowed,
         nodes=jax.lax.psum(res.nodes, axis),
-        sol_count=jax.lax.psum(res.sol_count, axis),
+        sol_count=sol_count,
         steps=res.steps,
         sweeps=jax.lax.psum(res.sweeps, axis),
         expansions=jax.lax.psum(res.expansions, axis),
@@ -279,6 +287,12 @@ def solve_csp_sharded(
     The solution field stays in raw problem-state form (like
     :func:`~distributed_sudoku_solver_tpu.ops.solve.solve_csp`).
     """
+    if config.step_impl == "fused":
+        # The fused kernel hardcodes the Sudoku kernels (solve_csp precedent).
+        raise ValueError(
+            "step_impl='fused' supports the Sudoku entry points only; "
+            f"got a generic {type(problem).__name__}"
+        )
     mesh = mesh if mesh is not None else default_mesh()
     return _solve_csp_sharded_jit(jnp.asarray(states0), problem, config, mesh)
 
@@ -287,6 +301,15 @@ def solve_csp_sharded(
 def _solve_sharded_jit(
     grids: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
 ) -> SolveResult:
+    if config.step_impl == "fused":
+        # One dispatch site (the solve_batch precedent): every sharded
+        # Sudoku entry point — including the wire path the bulk pipeline
+        # rides — honors the fused strategy.
+        from distributed_sudoku_solver_tpu.parallel.fused_sharded import (
+            _solve_fused_sharded_jit,
+        )
+
+        return _solve_fused_sharded_jit(grids, geom, config, mesh)
     res = _solve_csp_sharded_jit(
         encode_grid(grids, geom), sudoku_csp(geom, config), config, mesh
     )

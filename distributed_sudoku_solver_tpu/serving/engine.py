@@ -144,13 +144,6 @@ class SolverEngine:
         max_flights: int = 4,
         handicap_s: float = 0.0,
     ):
-        if solve_fn is None and config.step_impl != "xla":
-            # Same rule as _enqueue, for the engine-wide default config: a
-            # 'fused' default would silently run flights as 'xla'.
-            raise ValueError(
-                f"engine flights support step_impl='xla' only, got "
-                f"{config.step_impl!r}"
-            )
         self.config = config
         self.max_batch = max_batch
         self.batch_window_s = batch_window_s
@@ -217,16 +210,6 @@ class SolverEngine:
         return job
 
     def _enqueue(self, job: Job) -> None:
-        if job.config is not None and job.config.step_impl != "xla":
-            # Flights advance via the composite checkpoint path; silently
-            # running a 'fused' config as 'xla' would mislabel portfolio
-            # racers and A/B measurements (the branch_k precedent).  The
-            # fused kernel serves the batch entry points (ops/bulk,
-            # solve_batch); engine integration is future work.
-            raise ValueError(
-                f"engine flights support step_impl='xla' only, got "
-                f"{job.config.step_impl!r}"
-            )
         # Lock-ordered with stop()'s final drain: either this put happens
         # before the drain (and is swept by it), or _stop is already
         # visible here and we fail fast instead of stranding the caller.
@@ -469,6 +452,23 @@ class SolverEngine:
             req.done.set()  # result stays None: caller sees "not serviced"
 
     # -- flight path (default) ----------------------------------------------
+    @staticmethod
+    def _fit_fused(geom: Geometry, cfg: SolverConfig, would_be_lanes: int):
+        """Pin a fused flight's lane count to a kernel-valid width.
+
+        The fused kernel tiles lanes at 128 (``ops/pallas_step.fused_lanes``:
+        counts beyond 128 round up to a multiple, and the 128-lane tile must
+        fit scoped VMEM — raised here, so the flight fails loudly at launch
+        and the device loop errors its jobs rather than compiling).  The
+        composite path has no such constraint and keeps ``cfg`` untouched."""
+        if cfg.step_impl != "fused":
+            return cfg
+        from distributed_sudoku_solver_tpu.ops.pallas_step import fused_lanes
+
+        return dataclasses.replace(
+            cfg, lanes=fused_lanes(would_be_lanes, geom.n, cfg.stack_slots)
+        )
+
     def _launch_flights(
         self, geom: Geometry, cfg: SolverConfig, group: list[Job]
     ) -> None:
@@ -498,6 +498,7 @@ class SolverEngine:
         roots = np.zeros((bucket, geom.n, geom.n), np.uint32)
         roots[: len(r)] = r
         valid = np.arange(bucket) < len(r)
+        cfg = self._fit_fused(geom, cfg, cfg.resolve_lanes_packed(bucket))
         state = _start_packed(jnp.asarray(roots), jnp.asarray(valid), cfg)
         self._flights.append(_Flight(geom=geom, config=cfg, jobs=[job], state=state))
 
@@ -518,6 +519,7 @@ class SolverEngine:
         grids = np.stack([job.grid for job in jobs])
         roots[: len(jobs)] = np.asarray(encode_grid(jnp.asarray(grids), geom), np.uint32)
         job_of_root[: len(jobs)] = np.arange(len(jobs), dtype=np.int32)
+        cfg = self._fit_fused(geom, cfg, max(bucket, cfg.lanes, cfg.min_lanes))
         state = _start_roots(
             jnp.asarray(roots), jnp.asarray(job_of_root), bucket, cfg
         )
@@ -547,7 +549,17 @@ class SolverEngine:
         limit = jnp.int32(
             min(int(fl.state.steps) + self.chunk_steps, fl.config.max_steps)
         )
-        fl.state = advance_frontier(fl.state, limit, fl.geom, fl.config)
+        if fl.config.step_impl == "fused":
+            # The whole-round VMEM kernel advances the same Frontier in
+            # fused_steps-quantized chunks; purge/cancel/shed above and the
+            # finalize below are impl-agnostic (VERDICT r3 #1).
+            from distributed_sudoku_solver_tpu.ops.pallas_step import (
+                advance_frontier_fused,
+            )
+
+            fl.state = advance_frontier_fused(fl.state, limit, fl.geom, fl.config)
+        else:
+            fl.state = advance_frontier(fl.state, limit, fl.geom, fl.config)
         jax.block_until_ready(fl.state)
         fl.chunks += 1
         solved = np.asarray(fl.state.solved)

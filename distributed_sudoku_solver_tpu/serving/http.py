@@ -85,6 +85,14 @@ class _Handler(BaseHTTPRequestHandler):
         start = time.time()
         timeout = self.server.solve_timeout_s
         if payload.get("count_all"):
+            if payload.get("portfolio"):
+                # Racing heterogeneous configs makes sense for find-one (first
+                # verdict wins) but not for enumeration: every racer would run
+                # the identical exhaustive count.  Reject loudly rather than
+                # silently ignoring one of the two flags (ADVICE r3).
+                return self._send(
+                    400, {"error": "count_all and portfolio are mutually exclusive"}
+                )
             return self._solve_count_all(node, g, start, timeout)
         strategy = None
         if payload.get("portfolio"):
@@ -132,7 +140,13 @@ class _Handler(BaseHTTPRequestHandler):
         count, the first solution found (null if none), and whether the
         enumeration ran to completion.  A capability the reference cannot
         express at all — its search stops at the first solution
-        (``/root/reference/DHT_Node.py:474-538``)."""
+        (``/root/reference/DHT_Node.py:474-538``).
+
+        Enumeration runs on the LOCAL engine only, even on a cluster node:
+        shed NEEDWORK parts would be counted by the peer and aggregated
+        nowhere, so enumeration flights never shed (``serving/engine.py
+        _do_shed``) and the count needs no cross-node merge.  The response
+        carries ``"scope": "local"`` to surface that (ADVICE r3)."""
         import dataclasses
         import time
 
@@ -140,8 +154,15 @@ class _Handler(BaseHTTPRequestHandler):
         if engine is None:
             return self._send(500, {"error": "node has no engine"})
         try:
+            # Force the composite step: enumeration is unsupported by the
+            # fused kernel (SolverConfig rejects the combination), and an
+            # engine whose default config is fused must not turn that into
+            # a 400 blaming the client's well-formed request.
             job = engine.submit(
-                grid, config=dataclasses.replace(engine.config, count_all=True)
+                grid,
+                config=dataclasses.replace(
+                    engine.config, count_all=True, step_impl="xla"
+                ),
             )
         except ValueError as e:
             return self._send(400, {"error": str(e)})
@@ -157,6 +178,7 @@ class _Handler(BaseHTTPRequestHandler):
             "complete": bool(job.unsat and not job.cancelled),
             "solution": job.solution.tolist() if job.sol_count > 0 else None,
             "duration": time.time() - start,
+            "scope": "local",  # enumeration never distributes (see docstring)
         }
         return self._send(200, body)
 
