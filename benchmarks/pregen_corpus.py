@@ -34,18 +34,22 @@ if REPO not in sys.path:  # `python benchmarks/pregen_corpus.py` from anywhere
 
 
 def _one(args) -> np.ndarray:
-    seed, n_clues = args
+    seed, n_clues, unique = args
     from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
     from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
 
-    return make_puzzle(SUDOKU_9, seed, n_clues=n_clues)
+    return make_puzzle(SUDOKU_9, seed, n_clues=n_clues, unique=unique)
 
 
-def _carve(pool, count: int, seed: int, n_clues: int, label: str):
+def _carve(pool, count: int, seed: int, n_clues: int, label: str, unique=True):
     t0 = time.perf_counter()
     out = []
     for i, board in enumerate(
-        pool.imap(_one, ((seed + j, n_clues) for j in range(count)), chunksize=64)
+        pool.imap(
+            _one,
+            ((seed + j, n_clues, unique) for j in range(count)),
+            chunksize=64,
+        )
     ):
         out.append(board)
         if (i + 1) % 8192 == 0:
@@ -64,6 +68,15 @@ def main() -> None:
     ap.add_argument("--solvefile", type=int, default=0)  # e.g. 1_000_000
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--n-clues", type=int, default=24)
+    ap.add_argument(
+        "--solvefile-unique",
+        action="store_true",
+        help="uniqueness-probe the solve-file corpus too (30+ ms/puzzle of "
+        "native DFS probes per carve — ~9 h for 1M boards on this "
+        "container's single core; default skips the probes, which makes "
+        "boards possibly multi-solution but still distinct, satisfiable, "
+        "and n-clues-given — disclose the distribution wherever measured)",
+    )
     ap.add_argument("--workers", type=int, default=min(16, os.cpu_count() or 1))
     args = ap.parse_args()
 
@@ -77,12 +90,10 @@ def main() -> None:
 
     with mp.Pool(args.workers) as pool:
         if args.headline:
-            geom = SUDOKU_9
-            key = (
-                f"v{puzzles._GENERATOR_VERSION}_{geom.box_h}x{geom.box_w}"
-                f"_{args.headline}_{args.seed}_{args.n_clues}_1"
+            path = puzzles.batch_cache_path(
+                SUDOKU_9, args.headline, args.seed, args.n_clues,
+                unique=True, cache_dir=cache,
             )
-            path = os.path.join(cache, f"puzzles_{key}.npy")
             if os.path.exists(path):
                 print(f"[headline] already cached: {path}")
             else:
@@ -95,11 +106,17 @@ def main() -> None:
         if args.solvefile:
             # Non-overlapping seed range so the two corpora stay disjoint.
             sf_seed = args.seed + 1_000_000
-            path = os.path.join(cache, f"solvefile_{args.solvefile}_{sf_seed}.txt")
+            tag = "u" if args.solvefile_unique else "nu"
+            path = os.path.join(
+                cache, f"solvefile_{args.solvefile}_{sf_seed}_{tag}.txt"
+            )
             if os.path.exists(path):
                 print(f"[solvefile] already cached: {path}")
             else:
-                batch = _carve(pool, args.solvefile, sf_seed, args.n_clues, "solvefile")
+                batch = _carve(
+                    pool, args.solvefile, sf_seed, args.n_clues, "solvefile",
+                    unique=args.solvefile_unique,
+                )
                 tmp = f"{path}.{os.getpid()}.tmp"
                 with open(tmp, "w") as f:
                     for board in batch:

@@ -1,0 +1,190 @@
+"""uint16 candidate masks: measure or refute the roofline headroom claim.
+
+VERDICT r3 #3: the whole framework carries candidate masks as uint32, but
+9x9 needs 9 bits and 16x16 needs 16 — uint16 state would halve VMEM bytes
+per lane and potentially the vector work (v5e packs 16-bit lanes 2x per
+vreg).  Before refactoring three kernel files, this probe answers two
+questions on hardware:
+
+1. Does Mosaic LOWER the mask algebra (popcount / and-not folds /
+   group-reduce concat trees / while fixpoint) on uint16 vregs at all?
+2. If it lowers, what is the measured speedup of the propagation fixpoint
+   — the op mix that dominates the fused kernel's rounds?
+
+Method: the EXACT sweep algebra of ``ops/pallas_propagate.sweep_mosaic``
+(same helpers, dtype-parametrized literals), boards-last [n, n, T] tiles,
+fixpoint while-loop inside one ``pallas_call``; K=16 dispatch-chained
+iterations amortize tunnel overhead (the bench_suite protocol, including
+the roll-by-index defense against LICM/DCE).  A/B interleaved best-of-3.
+
+Run:  python benchmarks/probe_uint16.py [--batch 65536] [--tile 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def emit(**kw) -> None:
+    print(json.dumps(kw), flush=True)
+
+
+def build(jax, jnp, dtype):
+    from jax.experimental import pallas as pl
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9 as geom
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+        _OR,
+        _VMEM,
+        _fold,
+        _interpret_default,
+        _ot_comb,
+        _ot_lift,
+        _unit_maps,
+    )
+
+    del dtype  # dtype rides the input; literals must be Python ints
+    # (pallas_call rejects captured jnp scalars — the round-3 lowering rule)
+
+    def sweep(cand):
+        single = jax.lax.population_count(cand) == 1
+        decided = jnp.where(single, cand, 0)
+        seen = _fold(
+            list(_unit_maps(decided, geom, _OR, lambda v: v, 0, 1)), _OR
+        )
+        cand = jnp.where(single, cand, cand & ~seen)
+        forced = jnp.zeros_like(cand)
+        for once, twice in _unit_maps(cand, geom, _ot_comb, _ot_lift, 0, 1):
+            forced = forced | (cand & (once & ~twice))
+        return jnp.where(~single & (forced != 0), forced, cand)
+
+    def kernel(cand_ref, out_ref, *, max_sweeps):
+        def cond(s):
+            _, changed, k = s
+            return changed & (k < max_sweeps)
+
+        def body(s):
+            cur, _, k = s
+            nxt = sweep(cur)
+            return nxt, jnp.any(nxt != cur), k + 1
+
+        out, _, _ = jax.lax.while_loop(
+            cond, body, (cand_ref[...], jnp.bool_(True), jnp.int32(0))
+        )
+        out_ref[...] = out
+
+    interp = _interpret_default()
+    vmem = dict(memory_space=_VMEM) if (_VMEM is not None and not interp) else {}
+
+    @functools.partial(jax.jit, static_argnames=("tile",))
+    def fixpoint(cand_t, tile):
+        n = geom.n
+        n_lanes = cand_t.shape[-1]
+        spec = pl.BlockSpec((n, n, tile), lambda i: (0, 0, i), **vmem)
+        return pl.pallas_call(
+            functools.partial(kernel, max_sweeps=64),
+            grid=(n_lanes // tile,),
+            in_specs=[spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct(cand_t.shape, cand_t.dtype),
+            interpret=interp,
+        )(cand_t)
+
+    return fixpoint
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=65536)
+    ap.add_argument("--tile", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=16)
+    args = ap.parse_args()
+    os.environ.setdefault(
+        "DSST_PUZZLE_CACHE", os.path.join(REPO, ".cache", "puzzles")
+    )
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+    from distributed_sudoku_solver_tpu.utils.puzzles import puzzle_batch
+
+    base = puzzle_batch(SUDOKU_9, 512, seed=7, n_clues=24)
+    grids = np.tile(base, (args.batch // 512, 1, 1))
+    cand32_t = np.asarray(
+        encode_grid(jnp.asarray(grids), SUDOKU_9), np.uint32
+    ).transpose(1, 2, 0)
+
+    cases = {
+        "uint32": (jnp.uint32, jax.device_put(jnp.asarray(cand32_t))),
+        "uint16": (
+            jnp.uint16,
+            jax.device_put(jnp.asarray(cand32_t.astype(np.uint16))),
+        ),
+    }
+    k = args.iters
+
+    results: dict[str, float] = {}
+    outs: dict[str, np.ndarray] = {}
+    for name, (dt, cand) in cases.items():
+        fixpoint = build(jax, jnp, dt)
+
+        @jax.jit
+        def chained(x, fixpoint=fixpoint):
+            def body(i, acc):
+                return acc | fixpoint(jnp.roll(x, i, axis=-1), tile=args.tile)
+
+            return jax.lax.fori_loop(0, k, body, jnp.zeros_like(x))
+
+        try:
+            outs[name] = np.asarray(fixpoint(cand, tile=args.tile))
+            _ = np.asarray(chained(cand))  # warm / compile
+        except Exception as e:  # noqa: BLE001
+            emit(metric="uint16_probe", case=name, error=repr(e)[:500])
+            continue
+        results[name] = float("inf")
+        cases[name] = (dt, cand, chained)
+    for _ in range(3):  # interleaved best-of-3
+        for name, entry in cases.items():
+            if len(entry) != 3 or name not in results:
+                continue
+            _, cand, chained = entry
+            t0 = time.perf_counter()
+            _ = np.asarray(chained(cand))
+            results[name] = min(results[name], time.perf_counter() - t0)
+
+    bit_equal = None
+    if "uint32" in outs and "uint16" in outs:
+        bit_equal = bool(
+            (outs["uint32"].astype(np.uint16) == outs["uint16"]).all()
+        )
+    out = {
+        "metric": "uint16_probe",
+        "batch": args.batch,
+        "tile": args.tile,
+        "iters": k,
+        "bit_equal_low16": bit_equal,
+        "device": str(jax.devices()[0].platform),
+    }
+    for name, dt in results.items():
+        if np.isfinite(dt):
+            out[f"{name}_fixpoints_per_s"] = round(args.batch * k / dt, 1)
+            out[f"{name}_wall_s"] = round(dt, 3)
+    if all(np.isfinite(results.get(n, np.nan)) for n in ("uint32", "uint16")):
+        out["speedup_uint16"] = round(results["uint32"] / results["uint16"], 3)
+    emit(**out)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,10 +105,6 @@ class SolverConfig:
             raise ValueError(f"unknown step_impl {self.step_impl!r}")
         if self.step_impl == "fused" and self.branch_k != 2:
             raise ValueError("step_impl='fused' supports branch_k=2 only")
-        if self.step_impl == "fused" and self.count_all:
-            # The fused kernel freezes a lane on its first solve; silent
-            # undercounts would mislabel enumeration results.
-            raise ValueError("count_all is not supported with step_impl='fused'")
         if self.fused_steps < 1:
             # 0 would make every fused dispatch a no-op: the driver's outer
             # while (any live & steps < max) then spins forever in-graph.

@@ -64,6 +64,14 @@ _OUT_ROWS = 8
 _BIG = 2**30
 
 
+# Carried-state budget within the 16 MB scoped VMEM (see fused_tile).
+_VMEM_BUDGET = 4 << 20
+
+
+def _per_lane_bytes(n: int, stack_slots: int) -> int:
+    return (stack_slots + 9) * n * n * 4
+
+
 def fused_tile(n: int, stack_slots: int) -> int:
     """128 if a 128-lane tile's working set fits scoped VMEM, else 0.
 
@@ -74,10 +82,11 @@ def fused_tile(n: int, stack_slots: int) -> int:
     temporaries take the rest) is calibrated against measured compiles:
     9x9 S=12 fits at 128 (256 overflows by 218 KB), 16x16 S=64 needs
     33.5 MB at 256.  0 means the fused path cannot run at this
-    (n, stack_slots) beyond 128 lanes.
+    (n, stack_slots) beyond 128 lanes.  The +9 counts the non-stack
+    carries: top, first-solution capture, and the seven cell-uniform
+    per-lane counters (incl. the round-4 enumeration counter).
     """
-    per_lane = (stack_slots + 8) * n * n * 4
-    return 128 if 128 * per_lane <= (4 << 20) else 0
+    return 128 if 128 * _per_lane_bytes(n, stack_slots) <= _VMEM_BUDGET else 0
 
 
 def _bcast_reduce(x: jax.Array, axis: int, comb) -> jax.Array:
@@ -246,6 +255,7 @@ def _fused_kernel(
     out_solved,
     out_over,
     out_nodes,
+    out_solcnt,
     out_sweeps,
     out_steps,
     out_sol,
@@ -255,6 +265,7 @@ def _fused_kernel(
     branch_rule: str,
     max_sweeps: int,
     k_steps: int,
+    count_mode: bool,
 ):
     """Run up to ``k_steps`` whole frontier rounds on one VMEM lane tile.
 
@@ -283,18 +294,19 @@ def _fused_kernel(
     solved_f = jnp.zeros(shape, jnp.int32)
     overflow_f = jnp.zeros(shape, jnp.int32)
     nodes_d = jnp.zeros(shape, jnp.int32)
+    sols_d = jnp.zeros(shape, jnp.int32)  # count_mode: solutions this dispatch
     sweeps_d = jnp.int32(0)
     steps_d = jnp.int32(0)
     pick_low = branch_rule != "minrem-desc"
 
     def cond(c):
         (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-         nodes_d, sweeps_d, steps_d) = c
+         nodes_d, sols_d, sweeps_d, steps_d) = c
         return jnp.any(has_top > 0) & (steps_d < k_steps)
 
     def body(c):
         (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-         nodes_d, sweeps_d, steps_d) = c
+         nodes_d, sols_d, sweeps_d, steps_d) = c
         live = has_top > 0
         tops = jnp.where(live, top, jnp.uint32(0))
         tops, n_sweeps = _fixpoint_boards_last(tops, geom, max_sweeps, rules)
@@ -302,11 +314,16 @@ def _fused_kernel(
         top_solved = (slv > 0) & live
         top_contra = (con > 0) & live
 
-        # Solution capture: the lane freezes (job-level first-win and the
+        # First-solution capture (both modes; job-level first-win and the
         # purge of sibling lanes happen in XLA between dispatches).
         newly = top_solved & (solved_f == 0)
         sol = jnp.where(newly, tops, sol)
         solved_f = jnp.where(newly, 1, solved_f)
+        if count_mode:
+            # Enumeration (VERDICT r3 #5): EVERY solved top counts, and the
+            # lane does not freeze — it pops its next deferred subtree like
+            # a contradiction does, so the search runs to exhaustion.
+            sols_d = sols_d + jnp.where(top_solved, 1, 0)
 
         undecided = live & ~top_solved & ~top_contra
         onehot = branch_onehot_full(tops, geom, branch_rule)
@@ -320,25 +337,31 @@ def _fused_kernel(
         overflow_f = jnp.where(undecided & ~can_push, 1, overflow_f)
         nodes_d = nodes_d + jnp.where(undecided, 1, 0)
 
-        resolved = top_contra  # solved lanes freeze; contra lanes pop
+        if count_mode:
+            resolved = top_solved | top_contra  # solved lanes pop too
+        else:
+            resolved = top_contra  # solved lanes freeze; contra lanes pop
         can_pop = resolved & (count > 0)
         pop_slot = (base + count - 1) % s
         popped = _select_slot(stack, pop_slot, can_pop)
 
         top = jnp.where(undecided, guess, tops)
         top = jnp.where(can_pop, popped, top)
-        has_top = jnp.where(
-            live & ~top_solved & ~(resolved & ~can_pop), 1, 0
-        )
+        if count_mode:
+            has_top = jnp.where(live & ~(resolved & ~can_pop), 1, 0)
+        else:
+            has_top = jnp.where(
+                live & ~top_solved & ~(resolved & ~can_pop), 1, 0
+            )
         count = count + jnp.where(can_push, 1, 0) - jnp.where(can_pop, 1, 0)
         return (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-                nodes_d, sweeps_d + n_sweeps, steps_d + 1)
+                nodes_d, sols_d, sweeps_d + n_sweeps, steps_d + 1)
 
     (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-     nodes_d, sweeps_d, steps_d) = jax.lax.while_loop(
+     nodes_d, sols_d, sweeps_d, steps_d) = jax.lax.while_loop(
         cond, body,
         (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-         nodes_d, sweeps_d, steps_d),
+         nodes_d, sols_d, sweeps_d, steps_d),
     )
 
     out_top[...] = top
@@ -352,6 +375,7 @@ def _fused_kernel(
     out_solved[...] = solved_f[0:1, 0:1]
     out_over[...] = overflow_f[0:1, 0:1]
     out_nodes[...] = nodes_d[0:1, 0:1]
+    out_solcnt[...] = sols_d[0:1, 0:1]
     out_sweeps[...] = zero_row + sweeps_d
     out_steps[...] = zero_row + steps_d
 
@@ -360,7 +384,7 @@ def _fused_kernel(
     jax.jit,
     static_argnames=(
         "geom", "rules", "branch_rule", "max_sweeps", "k_steps", "tile",
-        "interpret",
+        "count_mode", "interpret",
     ),
 )
 def fused_rounds(
@@ -375,6 +399,7 @@ def fused_rounds(
     max_sweeps: int = 64,
     k_steps: int = 8,
     tile: int = 256,
+    count_mode: bool = False,
     interpret: bool | None = None,
 ):
     """Advance every lane up to ``k_steps`` frontier rounds in VMEM tiles.
@@ -382,7 +407,10 @@ def fused_rounds(
     Boards-last state: ``top_t`` uint32[n, n, L], ``stack_t`` uint32
     [S, n, n, L]; per-lane int32/bool vectors.  Returns ``(top_t, stack_t,
     has_top, base, count, lane_solved, lane_sol_t, lane_overflow,
-    nodes_delta, sweeps_total, steps_max)``.
+    nodes_delta, sols_delta, sweeps_total, steps_max)``.  With
+    ``count_mode`` (enumeration), solved lanes pop and continue instead of
+    freezing, and ``sols_delta`` counts every solved top; ``lane_solved`` /
+    ``lane_sol_t`` still report each lane's FIRST solution this dispatch.
     """
     n = geom.n
     n_lanes = top_t.shape[-1]
@@ -407,6 +435,7 @@ def fused_rounds(
         branch_rule=branch_rule,
         max_sweeps=max_sweeps,
         k_steps=k_steps,
+        count_mode=count_mode,
     )
     vmem = dict(memory_space=_VMEM) if (_VMEM is not None and not interp) else {}
     lane_spec = lambda *lead: pl.BlockSpec(  # noqa: E731
@@ -414,7 +443,7 @@ def fused_rounds(
     )
     row_shape = jax.ShapeDtypeStruct((1, 1, n_lanes), jnp.int32)
     (out_top, out_stack, o_has, o_base, o_cnt, o_solved, o_over, o_nodes,
-     o_sweeps, o_steps, out_sol) = pl.pallas_call(
+     o_solcnt, o_sweeps, o_steps, out_sol) = pl.pallas_call(
         kernel,
         grid=(n_tiles,),
         in_specs=[
@@ -427,13 +456,13 @@ def fused_rounds(
         out_specs=(
             lane_spec(n, n),
             lane_spec(s, n, n),
-            *([lane_spec(1, 1)] * 8),
+            *([lane_spec(1, 1)] * 9),
             lane_spec(n, n),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(top_t.shape, jnp.uint32),
             jax.ShapeDtypeStruct(stack_t.shape, jnp.uint32),
-            *([row_shape] * 8),
+            *([row_shape] * 9),
             jax.ShapeDtypeStruct(top_t.shape, jnp.uint32),
         ),
         interpret=interp,
@@ -453,6 +482,7 @@ def fused_rounds(
         out_sol,
         o_over[0, 0] > 0,
         o_nodes[0, 0],
+        o_solcnt[0, 0],
         sweeps_total,
         steps_max,
     )
@@ -537,10 +567,20 @@ def fused_lanes(n_lanes: int, n: int, stack_slots: int) -> int:
 
     Mosaic accepts a lane-tile that is either the whole array (any width
     <= 128 here) or a multiple of 128 (:func:`fused_tile`), so beyond 128
-    lanes the count rounds up to the next multiple of 128 — and the
-    128-lane tile's working set must fit scoped VMEM, a static property of
-    ``(n, stack_slots)``.  Raises if it cannot."""
+    lanes the count rounds up to the next multiple of 128.  Either way the
+    tile's working set must fit the scoped-VMEM carried-state budget — a
+    static property of ``(n, stack_slots, tile width)`` — so an unfittable
+    config raises HERE, a clean launch-time error, not an opaque Mosaic
+    compile failure at first dispatch (a <=128-lane whole-array tile on a
+    giant board can overflow just as surely as the 128-tile: 25x25 at
+    S=64 is ~182 KB/lane)."""
     if n_lanes <= 128:
+        if n_lanes * _per_lane_bytes(n, stack_slots) > _VMEM_BUDGET:
+            raise ValueError(
+                f"step_impl='fused' would overflow scoped VMEM at n={n}, "
+                f"stack_slots={stack_slots}, lanes={n_lanes} (whole-array "
+                f"tile); use step_impl='xla' or a shallower stack"
+            )
         return n_lanes
     if fused_tile(n, stack_slots) == 0:
         raise ValueError(
@@ -599,7 +639,7 @@ def _fused_round(fs: FusedFrontier, geom: Geometry, config) -> FusedFrontier:
     job_safe = jnp.clip(fs.job, 0, n_jobs - 1)
 
     (top_t, stack_t, has_top, base, count, lane_solved, lane_sol_t,
-     lane_over, nodes_d, sweeps_t, steps_m) = fused_rounds(
+     lane_over, nodes_d, sols_d, sweeps_t, steps_m) = fused_rounds(
         fs.top_t, fs.stack_t, fs.has_top, fs.base, fs.count,
         geom,
         rules=config.rules,
@@ -609,21 +649,43 @@ def _fused_round(fs: FusedFrontier, geom: Geometry, config) -> FusedFrontier:
         # Lanes were validated/rounded by solve_batch_fused: <= 128 lanes
         # use one full-array tile, beyond that always 128-lane tiles.
         tile=min(128, n_lanes),
+        count_mode=config.count_all,
     )
-
-    # First-lane-wins harvest per job (the composite step's exact rule).
-    eligible = lane_solved & (fs.job >= 0) & ~fs.solved[job_safe]
-    scatter_job = jnp.where(eligible, fs.job, n_jobs)
-    lane_ids = jnp.arange(n_lanes, dtype=jnp.int32)
-    first = jnp.full(n_jobs, n_lanes, jnp.int32).at[scatter_job].min(
-        jnp.where(eligible, lane_ids, n_lanes), mode="drop"
-    )
-    newly = (first < n_lanes) & ~fs.solved
-    sol_rows = lane_sol_t[:, :, jnp.clip(first, 0, n_lanes - 1)]
-    solution_t = jnp.where(newly[None, None, :], sol_rows, fs.solution_t)
-    solved = fs.solved | newly
 
     live_jobs = fs.job >= 0
+    lane_ids = jnp.arange(n_lanes, dtype=jnp.int32)
+    if config.count_all:
+        # Enumeration: jobs never resolve; every solved top adds to the
+        # job's model count, and the job keeps the first solution any of
+        # its lanes captured (which solution — not whether/how many — may
+        # differ from the composite path: lanes run fused_steps rounds
+        # between harvests, the same approximation as purge/steal).
+        sol_count = fs.sol_count.at[
+            jnp.where(live_jobs, fs.job, n_jobs)
+        ].add(sols_d, mode="drop")
+        had_sol = fs.sol_count > 0
+        eligible = lane_solved & live_jobs & ~had_sol[job_safe]
+        scatter_job = jnp.where(eligible, fs.job, n_jobs)
+        first = jnp.full(n_jobs, n_lanes, jnp.int32).at[scatter_job].min(
+            jnp.where(eligible, lane_ids, n_lanes), mode="drop"
+        )
+        newly = (first < n_lanes) & ~had_sol
+        sol_rows = lane_sol_t[:, :, jnp.clip(first, 0, n_lanes - 1)]
+        solution_t = jnp.where(newly[None, None, :], sol_rows, fs.solution_t)
+        solved = fs.solved
+    else:
+        # First-lane-wins harvest per job (the composite step's exact rule).
+        eligible = lane_solved & live_jobs & ~fs.solved[job_safe]
+        scatter_job = jnp.where(eligible, fs.job, n_jobs)
+        first = jnp.full(n_jobs, n_lanes, jnp.int32).at[scatter_job].min(
+            jnp.where(eligible, lane_ids, n_lanes), mode="drop"
+        )
+        newly = (first < n_lanes) & ~fs.solved
+        sol_rows = lane_sol_t[:, :, jnp.clip(first, 0, n_lanes - 1)]
+        solution_t = jnp.where(newly[None, None, :], sol_rows, fs.solution_t)
+        solved = fs.solved | newly
+        sol_count = solved.astype(jnp.int32)
+
     overflowed = fs.overflowed.at[
         jnp.where(lane_over & live_jobs, fs.job, n_jobs)
     ].set(True, mode="drop")
@@ -653,7 +715,7 @@ def _fused_round(fs: FusedFrontier, geom: Geometry, config) -> FusedFrontier:
         solution_t=solution_t,
         overflowed=overflowed,
         nodes=nodes,
-        sol_count=solved.astype(jnp.int32),
+        sol_count=sol_count,
         steps=fs.steps + steps_m,
         sweeps=fs.sweeps + sweeps_t,
         expansions=fs.expansions + jnp.sum(nodes_d),
@@ -720,10 +782,12 @@ def solve_batch_fused(
     """Fused-step batched Sudoku solve (``SolverConfig.step_impl='fused'``).
 
     Same contract as ``ops.solve.solve_batch`` (solved / proven-unsat /
-    unknown verdicts, int-grid solutions) under the fused round semantics:
-    purge/steal react at ``fused_steps`` granularity, so node counts differ
-    from the composite step while every verdict stays sound
-    (``tests/test_fused_step.py``).
+    unknown verdicts, int-grid solutions; exact ``sol_count`` model counts
+    under ``count_all`` enumeration) under the fused round semantics:
+    purge/steal react at ``fused_steps`` granularity, so node counts — and
+    under ``count_all``, *which* first-found solution is reported (never
+    the count) — differ from the composite step while every verdict stays
+    sound (``tests/test_fused_step.py``).
 
     Step accounting is an approximation (ADVICE r3): each dispatch advances
     ``steps`` by the MAX in-kernel rounds across tiles, so a lane in a tile

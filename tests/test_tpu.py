@@ -195,3 +195,92 @@ def test_fused_step_kernel_on_device():
     np.testing.assert_array_equal(
         np.asarray(got.solution), np.asarray(ref.solution)
     )
+
+
+def test_fused_engine_flight_on_device():
+    """Fused configs serving engine flights on hardware (VERDICT r3 #1):
+    the advance_frontier_fused chunk driver compiles through Mosaic and
+    resolves jobs with oracle-valid solutions."""
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+    eng = SolverEngine(
+        config=SolverConfig(min_lanes=64, stack_slots=16, step_impl="fused"),
+        max_batch=8,
+    ).start()
+    try:
+        jobs = [eng.submit(p) for p in (EASY_9, *HARD_9)]
+        for j in jobs:
+            assert j.wait(240)
+            assert j.solved, j.error
+            assert is_valid_solution(j.solution)
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_fused_sharded_one_chip_mesh_on_device():
+    """The fused kernel under shard_map on a 1-chip mesh (the only size
+    this container offers): Mosaic inside shard_map compiles + solves."""
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.parallel import make_mesh
+    from distributed_sudoku_solver_tpu.parallel.fused_sharded import (
+        solve_batch_fused_sharded,
+    )
+    from distributed_sudoku_solver_tpu.utils.oracle import solve_oracle
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+    grids = np.stack([EASY_9, HARD_9[0]]).astype(np.int32)
+    cfg = SolverConfig(
+        min_lanes=128, stack_slots=16, max_steps=4096, step_impl="fused"
+    )
+    res = solve_batch_fused_sharded(
+        jnp.asarray(grids), SUDOKU_9, cfg, mesh=make_mesh(jax.devices()[:1])
+    )
+    assert np.asarray(res.solved).all()
+    for j in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(res.solution[j]), solve_oracle(grids[j], SUDOKU_9)
+        )
+
+
+def test_fused_count_all_on_device():
+    """In-kernel enumeration on hardware: the count-mode kernel (solved
+    lanes pop and continue) compiles through Mosaic and produces exact
+    model counts (288 4x4 grids; 62-solution 9x9)."""
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_4, SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+    res4 = solve_batch(
+        jnp.asarray(np.zeros((1, 4, 4), np.int32)),
+        SUDOKU_4,
+        SolverConfig(
+            min_lanes=32, stack_slots=64, max_steps=100_000,
+            count_all=True, step_impl="fused",
+        ),
+    )
+    assert int(res4.sol_count[0]) == 288
+    assert bool(res4.unsat[0])
+
+    few = np.asarray(EASY_9).copy()
+    rng = np.random.default_rng(3)
+    idx = np.flatnonzero(few.ravel())
+    few.ravel()[rng.choice(idx, size=4, replace=False)] = 0
+    res9 = solve_batch(
+        jnp.asarray(few[None].astype(np.int32)),
+        SUDOKU_9,
+        SolverConfig(
+            min_lanes=64, stack_slots=32, max_steps=100_000,
+            count_all=True, step_impl="fused",
+        ),
+    )
+    assert int(res9.sol_count[0]) == 62
+    assert bool(res9.unsat[0])
