@@ -1,0 +1,94 @@
+"""Device-backed solve-file endurance loop (VERDICT r3 #10).
+
+Runs `utils.dataset.solve_file` over a corpus repeatedly in ONE process
+(so jit caches, device buffers, and transfer pools age realistically),
+appending one JSON line per pass — throughput, RSS, fd count — to
+``--log``.  The analysis at the end of the run (or any time, from the
+log) is the same contract as the churn soak: post-warmup RSS slope and
+fd stability, plus throughput steadiness (no monotonic decay).
+
+    python benchmarks/endurance_solvefile.py --input <corpus> --hours 3
+
+Stops cleanly at the time budget (finishes the pass in flight), so it
+can run under the TPU watchdog protocol: every device dispatch inside
+solve_file is already step-capped/chunked (ops/bulk.py dispatch bounds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--hours", type=float, default=3.0)
+    ap.add_argument("--size", type=int, default=9)
+    ap.add_argument("--batch", type=int, default=65536)
+    ap.add_argument("--log", default="/tmp/endurance_solvefile.jsonl")
+    args = ap.parse_args()
+    os.environ.setdefault(
+        "DSST_PUZZLE_CACHE", os.path.join(REPO, ".cache", "puzzles")
+    )
+
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".cache", "xla")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig
+    from distributed_sudoku_solver_tpu.utils import dataset
+
+    geom = geometry_for_size(args.size)
+    deadline = time.monotonic() + args.hours * 3600
+    t_start = time.monotonic()
+    n_pass = 0
+    with open(args.log, "a") as log:
+        while time.monotonic() < deadline:
+            t0 = time.perf_counter()
+            stats = dataset.solve_file(
+                args.input, None, geom, batch=args.batch,
+                bulk_config=BulkConfig(),
+            )
+            dt = time.perf_counter() - t0
+            n_pass += 1
+            rec = {
+                "pass": n_pass,
+                "t_min": round((time.monotonic() - t_start) / 60, 2),
+                "boards": stats["total"],
+                "solved": stats["solved"],
+                "boards_per_s": round(stats["total"] / dt, 1),
+                "wall_s": round(dt, 2),
+                "rss_mb": round(rss_mb(), 1),
+                "fds": fd_count(),
+            }
+            log.write(json.dumps(rec) + "\n")
+            log.flush()
+            print(json.dumps(rec), flush=True)
+    print(json.dumps({"done": True, "passes": n_pass}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -229,10 +229,13 @@ def solve_bulk(
     chunk = max(n_dev, -(-chunk // n_dev) * n_dev)
     step_impl = config.step_impl
     if step_impl is None:
-        # Auto-fused only where it is measured to win (9x9-class boards,
-        # BENCHMARKS.md: 2.2x) AND the (n, stack_slots) working set fits
-        # VMEM at the mandatory 128-lane tile (ops/pallas_step.fused_tile).
-        # Meshes qualify since round 4: the sharded driver dispatches to
+        # Auto-fused wherever the (n, stack_slots) working set fits VMEM at
+        # the mandatory 128-lane tile (ops/pallas_step.fused_tile) — that
+        # covers 9x9-class (measured 1.45-2.4x, BENCHMARKS.md) and, since
+        # round 4, 16x16 at S=12 (measured 1.1-2.0x across 512-2048-board
+        # corpora; the r3 "fused loses at 16x16" reading did not reproduce
+        # and is retired).  25x25 never fits and stays composite.  Meshes
+        # qualify too: the sharded driver dispatches to
         # parallel/fused_sharded (per-chip fused rounds + ring collectives).
         from distributed_sudoku_solver_tpu.ops.pallas_step import fused_tile
 
@@ -240,7 +243,6 @@ def solve_bulk(
             "fused"
             if (
                 jax.default_backend() == "tpu"
-                and n <= 12
                 and fused_tile(n, config.stack_slots) > 0
             )
             else "xla"

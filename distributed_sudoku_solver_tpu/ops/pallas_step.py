@@ -64,12 +64,30 @@ _OUT_ROWS = 8
 _BIG = 2**30
 
 
-# Carried-state budget within the 16 MB scoped VMEM (see fused_tile).
-_VMEM_BUDGET = 4 << 20
-
-
 def _per_lane_bytes(n: int, stack_slots: int) -> int:
+    # +9 counts the non-stack carries: top, first-solution capture, and the
+    # seven cell-uniform per-lane counters (incl. the enumeration counter).
     return (stack_slots + 9) * n * n * 4
+
+
+def _vmem_budget(n: int) -> int:
+    """Carried-state budget (bytes) for one kernel tile, by geometry.
+
+    Mosaic temporaries (fixpoint intermediates, concat trees) consume a
+    geometry-dependent multiple of the carried state on top of it inside
+    the 16 MB scoped limit, so one global constant mispredicts: the budget
+    is calibrated against measured 128-lane-tile compiles on v5e
+    (round 4): 9x9 S=24 compiles (1.37 MB carried), S=28 OOMs (1.53 MB);
+    16x16 S=12 compiles (2.75 MB), S=16 OOMs (3.28 MB).  The multiplier
+    SHRINKS with n (~11x at 9x9, ~5.3x at 16x16), so interpolating to
+    unmeasured geometries (13 <= n <= 15) could admit configs past the
+    edge — those return 0 (fused unavailable) until measured.
+    """
+    if n <= 12:
+        return 1_400_000
+    if n == 16:
+        return 2_800_000
+    return 0  # unmeasured geometry: no calibration point, no admission
 
 
 def fused_tile(n: int, stack_slots: int) -> int:
@@ -77,16 +95,11 @@ def fused_tile(n: int, stack_slots: int) -> int:
 
     Mosaic requires the block's lane dimension to be a multiple of 128 (or
     equal to the whole array), so 128 is the ONLY viable tile width once
-    lanes exceed 128 — there is no "shrink the tile" escape hatch.  The
-    4 MB carried-state budget (of the 16 MB scoped limit; fixpoint
-    temporaries take the rest) is calibrated against measured compiles:
-    9x9 S=12 fits at 128 (256 overflows by 218 KB), 16x16 S=64 needs
-    33.5 MB at 256.  0 means the fused path cannot run at this
-    (n, stack_slots) beyond 128 lanes.  The +9 counts the non-stack
-    carries: top, first-solution capture, and the seven cell-uniform
-    per-lane counters (incl. the round-4 enumeration counter).
+    lanes exceed 128 — there is no "shrink the tile" escape hatch.  0
+    means the fused path cannot run at this (n, stack_slots) beyond 128
+    lanes; see :func:`_vmem_budget` for the measured calibration.
     """
-    return 128 if 128 * _per_lane_bytes(n, stack_slots) <= _VMEM_BUDGET else 0
+    return 128 if 128 * _per_lane_bytes(n, stack_slots) <= _vmem_budget(n) else 0
 
 
 def _bcast_reduce(x: jax.Array, axis: int, comb) -> jax.Array:
@@ -575,7 +588,7 @@ def fused_lanes(n_lanes: int, n: int, stack_slots: int) -> int:
     giant board can overflow just as surely as the 128-tile: 25x25 at
     S=64 is ~182 KB/lane)."""
     if n_lanes <= 128:
-        if n_lanes * _per_lane_bytes(n, stack_slots) > _VMEM_BUDGET:
+        if n_lanes * _per_lane_bytes(n, stack_slots) > _vmem_budget(n):
             raise ValueError(
                 f"step_impl='fused' would overflow scoped VMEM at n={n}, "
                 f"stack_slots={stack_slots}, lanes={n_lanes} (whole-array "
