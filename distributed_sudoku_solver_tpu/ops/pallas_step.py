@@ -81,7 +81,12 @@ def _vmem_budget(n: int) -> int:
     16x16 S=12 compiles (2.75 MB), S=16 OOMs (3.28 MB).  The multiplier
     SHRINKS with n (~11x at 9x9, ~5.3x at 16x16), so interpolating to
     unmeasured geometries (13 <= n <= 15) could admit configs past the
-    edge — those return 0 (fused unavailable) until measured.
+    edge — those return 0 (fused unavailable) until measured.  Known
+    conservatism: the 9x9-calibrated constant also governs 10 <= n <= 12,
+    where the shrinking multiplier suggests deeper stacks would fit
+    (e.g. 12x12 S=12 at 1.55 MB is rejected but very likely compiles) —
+    admitting them needs a measured compile probe, not a trend guess
+    (ROADMAP r4 note).
     """
     if n <= 12:
         return 1_400_000

@@ -27,11 +27,30 @@ from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
 from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
 
 #: A sensible default portfolio: the two digit orders hedge each other's
-#: worst case; the reference-order racer adds cell-choice diversity.
+#: worst case; the reference-order racer adds cell-choice diversity; the
+#: fused racer (round 4 — engine flights accept step_impl='fused') adds a
+#: step-engine axis: it advances rounds ~2.4x faster per chunk where the
+#: geometry + stack fit the kernel's measured VMEM budget (9x9 at these
+#: settings; 16x16 needs S<=12 so this S=32 racer sits out there too),
+#: while the composite racers keep exact per-round purge/steal
+#: reactivity.  Wherever the kernel cannot serve, the fused racer's
+#: flight fails loudly at launch and the OTHER racers decide the race —
+#: an errored racer resolves without a verdict and never blocks a winner
+#: (tests/test_portfolio.py).
 DEFAULT_PORTFOLIO: tuple[SolverConfig, ...] = (
     SolverConfig(branch="minrem"),
     SolverConfig(branch="minrem-desc"),
     SolverConfig(branch="first"),
+    # lanes=64 is a HARD cap, not just a width: the engine groups
+    # same-config jobs into one flight and buckets by batch size, so an
+    # uncapped fused racer under 65+ concurrent races would resolve to a
+    # 128-lane flight whose 128 x (32+9)-row tile overflows the measured
+    # VMEM budget and errors every fused racer in the batch.  With the cap
+    # the engine splits the group into 64-lane flights instead.
+    SolverConfig(
+        branch="minrem", step_impl="fused", fused_steps=4, stack_slots=32,
+        lanes=64,
+    ),
 )
 
 

@@ -151,3 +151,47 @@ def test_race_unsat_verdict_wins():
         assert res.winner.unsat and not res.winner.solved
     finally:
         eng.stop(timeout=2)
+
+
+def test_default_portfolio_includes_fused_axis_and_races():
+    """Round 4: the default portfolio carries a fused racer; the race on a
+    9x9 board reaches a correct verdict with all four axes live."""
+    from distributed_sudoku_solver_tpu.serving.portfolio import DEFAULT_PORTFOLIO
+
+    assert any(c.step_impl == "fused" for c in DEFAULT_PORTFOLIO)
+    eng = SolverEngine(max_flights=8).start()
+    try:
+        res = race(
+            eng, np.asarray(HARD_9[2], np.int32), DEFAULT_PORTFOLIO, timeout=240
+        )
+        assert res.winner is not None and res.winner.solved
+        assert is_valid_solution(res.winner.solution)
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_fused_racer_failure_never_blocks_the_race():
+    """On a geometry the fused kernel cannot serve, the fused racer's
+    flight fails loudly at launch and the composite racers still decide
+    the race (the docstring contract on DEFAULT_PORTFOLIO)."""
+    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+    from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
+
+    g25 = geometry_for_size(25)
+    board = make_puzzle(g25, seed=11, n_clues=500, unique=False)  # propagation-easy
+    configs = [
+        SolverConfig(min_lanes=4, stack_slots=16, max_steps=4096),
+        SolverConfig(
+            min_lanes=4, stack_slots=16, max_steps=4096, step_impl="fused"
+        ),  # 25x25: no VMEM calibration point -> flight launch raises
+    ]
+    eng = SolverEngine(max_flights=8).start()
+    try:
+        res = race(eng, np.asarray(board, np.int32), configs, timeout=300)
+        assert res.winner is not None and res.winner.solved
+        assert res.winner_index == 0
+        fused_job = res.jobs[1]
+        assert fused_job.wait(30)
+        assert fused_job.error and "VMEM" in fused_job.error
+    finally:
+        eng.stop(timeout=2)
