@@ -221,6 +221,39 @@ class _Exec:
                 p["rehomed"] = False
 
     def on_part_result(self, part_uuid: str, msg: dict) -> None:
+        if msg.get("error") and not msg.get("solved") and not msg.get("unsat"):
+            # A FAILED execution, not an exhaustion verdict: the peer's
+            # engine drained the part during shutdown, or its flight could
+            # not launch (any no-verdict error qualifies — keying on one
+            # error string would let other failures mark the part done,
+            # free the recovery rows, and leave the subtree silently
+            # unsearched; the SOLUTION-path twin of this hole lost a whole
+            # job in the round-4 device-backed churn soak).  Re-enter the
+            # retained rows locally right away — waiting for view-change
+            # recovery would hang forever when the peer stays in the view
+            # (engine restarted, node alive).  If re-entry itself fails,
+            # clear the flag so deadline/view recovery retries later.
+            with self.lock:
+                info = self.parts.get(part_uuid)
+                if info is None or info["done"] or self.finalized:
+                    return
+                rows_packed, cfg = info["rows"], info["config"]
+                if rows_packed is None:
+                    return  # nothing retained; view-change recovery owns it
+                info["rehomed"] = True
+            try:
+                self.node._on_subtask(
+                    {
+                        "part": part_uuid,
+                        "root": self.uuid,
+                        "rows": rows_packed,
+                        "config": cfg,
+                        "report_to": self.node.addr_s,
+                    }
+                )
+            except Exception:  # noqa: BLE001 - e.g. our own engine stopping
+                self.unmark_rehomed(part_uuid)
+            return
         with self.lock:
             info = self.parts.get(part_uuid)
             if info is None or info["done"]:
@@ -984,6 +1017,7 @@ class ClusterNode:
                 "solved": r["solved"],
                 "unsat": r["unsat"],
                 "nodes": r["nodes"],
+                "error": r.get("error"),
                 "solution": r["solution"].tolist()
                 if r["solution"] is not None
                 else None,
@@ -1049,6 +1083,30 @@ class ClusterNode:
             ex.on_part_result(msg["part"], msg)
 
     def _on_solution(self, msg: dict) -> None:
+        if (
+            msg.get("error")
+            and not msg.get("solved")
+            and not msg.get("unsat")
+            and not msg.get("cancelled")
+        ):
+            # A FAILED remote execution, not a verdict: the member's engine
+            # drained the job during shutdown (a kill/stop racing the
+            # dispatch), or its flight errored.  Such a result reaches us
+            # BEFORE failure detection does, so without this filter it
+            # would pop the ledger and finalize the client's job unsolved
+            # while the death-repair re-execution path never gets its
+            # chance.  Found by the round-4 device-backed churn soak (one
+            # lost job in 2 h of churn; the oracle-backed lane's instant
+            # solves could not hit the window).  Re-execute from the ledger
+            # immediately — faster than waiting for the heartbeat deadline;
+            # a deterministic config error simply fails once more locally
+            # and surfaces with its error set (budget exhaustion carries no
+            # error and still finalizes normally).
+            with self._lock:
+                known = msg["uuid"] in self._ledger
+            if known:
+                self._reexecute(msg["uuid"])
+            return
         with self._lock:
             entry = self._ledger.pop(msg["uuid"], None)
         if entry is None:

@@ -31,6 +31,19 @@ never soundness.  The fused path is therefore a **gated strategy**
 (``tests/test_fused_step.py``), not a bit-exact re-encoding of the XLA
 step — same contract as ``branch_k``.
 
+Enumeration (``SolverConfig.count_all``) rides the kernel since round 4:
+in count mode a solved lane pops its next subtree instead of freezing,
+and a per-lane solution counter scatter-adds into job counts per dispatch
+— measured 3.31x over the composite step with bit-identical exact counts
+(BENCHMARKS.md).  Scope: the kernel hardcodes the SUDOKU propagation /
+status / branch algebra (the fixpoint, the unit reductions, the digit
+branch), so the generalized exact-cover family (``models/cover.py``:
+n-queens, pentomino) keeps the composite step — serving cover instances
+from VMEM would be a second kernel over the packed row-conflict algebra,
+not a flag on this one.  That is an architectural boundary, not a
+measured refutation: the cover family's 1.8-2.7x-over-native wins
+(BENCHMARKS.md) stand to gain similarly if that kernel is ever built.
+
 Reference bar: this is the hot loop of ``/root/reference/DHT_Node.py:
 474-538`` (recursive guess/validate/backtrack) as one resident TPU kernel.
 """

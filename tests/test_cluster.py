@@ -384,3 +384,96 @@ def test_stats_aggregation(trio):
     assert len(stats["nodes"]) == 3
     total = sum(n["validations"] or 0 for n in stats["nodes"])
     assert stats["all"]["validations"] == total == 4 * 7
+
+
+def test_engine_stopped_solution_reexecutes_not_finalizes():
+    """Round-4 soak finding: a member whose engine is stopping drains its
+    jobs with error='engine stopped' and pushes that NON-verdict back as a
+    SOLUTION — which used to beat failure detection to the origin's
+    ledger and finalize the client's job unsolved.  The origin must treat
+    it as a failed execution and re-execute from the ledger instead."""
+    a = make_node()
+    try:
+        g = np.asarray(EASY_9, np.int32)
+        # Manufacture the ledger state _submit_remote leaves behind for a
+        # job shipped to a (here: fictitious) member.
+        from distributed_sudoku_solver_tpu.cluster.node import Job as CJob
+
+        ju = f"{a.addr_s}/test-engine-stopped"
+        handle = CJob(uuid=ju, grid=g, geom=a_geom(g))
+        with a._lock:
+            a._ledger[ju] = {
+                "grid": g, "member": "127.0.0.1:1", "job": handle,
+                "config": None,
+            }
+        a._track("127.0.0.1:1", +1)
+        a._on_solution(
+            {
+                "method": "SOLUTION", "uuid": ju, "solved": False,
+                "unsat": False, "cancelled": False, "nodes": 0,
+                "error": "engine stopped", "solution": None,
+            }
+        )
+        assert handle.done.wait(30), "job neither re-executed nor finalized"
+        assert handle.solved, (
+            f"engine-stopped drain finalized the job unsolved "
+            f"(error={handle.error!r})"
+        )
+        assert is_valid_solution(handle.solution)
+        with a._lock:
+            assert ju not in a._ledger  # re-execution consumed the entry
+    finally:
+        a.kill()
+        a.engine.stop(timeout=1)
+
+
+def a_geom(g):
+    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+
+    return geometry_for_size(g.shape[0])
+
+
+def test_errored_part_result_never_counts_as_verdict():
+    """The PART_RESULT twin: a part drained by a stopping peer engine (or
+    failed by any no-verdict error) must never be marked done — it
+    re-enters locally, and if that re-entry itself fails, the part stays
+    pending with its recovery rows retained for deadline/view recovery."""
+    from distributed_sudoku_solver_tpu.cluster.node import _Exec, pack_rows
+    from distributed_sudoku_solver_tpu.serving.engine import Job as EngineJob
+
+    a = make_node()
+    try:
+        g = np.asarray(EASY_9, np.int32)
+        # An unresolved local job handle: the aggregate must stay live so
+        # the part bookkeeping (not finalization) is what's under test.
+        eng_job = EngineJob(uuid="x-part-test", grid=g, geom=a_geom(g))
+        ex = _Exec(a, eng_job, on_final=lambda r: None)
+        rows = pack_rows(np.ones((2, 9, 9), np.uint32))
+        assert ex.add_part("p1", "127.0.0.1:2", rows_packed=rows, config=None)
+        # Make the immediate local re-entry fail deterministically: with
+        # the engine stopped, _on_subtask's submit_roots raises — the
+        # fallback branch (stay pending, rows retained, flag cleared for a
+        # later recovery pass) is what's pinned here.
+        a.engine.stop(timeout=2)
+        ex.on_part_result(
+            "p1",
+            {"solved": False, "unsat": False, "nodes": 3,
+             "error": "engine stopped", "solution": None},
+        )
+        with ex.lock:
+            p = ex.parts["p1"]
+            assert not p["done"], "errored part wrongly counted as verdict"
+            assert p["rows"] is not None, "recovery rows freed prematurely"
+            assert not p["rehomed"], "failed re-entry must clear the flag"
+        # A real exhaustion verdict still lands normally afterwards.
+        ex.on_part_result(
+            "p1",
+            {"solved": False, "unsat": True, "nodes": 3,
+             "error": None, "solution": None},
+        )
+        with ex.lock:
+            assert ex.parts["p1"]["done"]
+            assert ex.parts["p1"]["exhausted"]
+    finally:
+        a.kill()
+        a.engine.stop(timeout=1)

@@ -224,7 +224,10 @@ def test_churn_soak_under_load():
                 lambda: len(a.network) == 1 + len(extras), timeout=20
             ), f"view never converged after join (cycle {cycle})"
         assert cycle >= 3, "soak too short to mean anything"
-        assert not pump_failures, pump_failures
+        # Leak-curve evidence prints BEFORE any load-correctness assertion:
+        # the round-4 device-backed run lost its whole 2 h RSS/fd record
+        # because a pump failure (a real recovery bug, since fixed) raised
+        # first — a soak must never discard the measurements it ran for.
         samples.append((time.monotonic() - t0, rss_mb(), fd_count()))
         warm = samples[len(samples) // 3 :]  # drop compile/pool warmup
         if len(warm) >= 5:
