@@ -146,6 +146,8 @@ class _Exec:
         self.on_final = on_final
         self.base_nodes = base_nodes
         self.parts: dict[str, dict] = {}  # part_uuid -> {peer, done, exhausted, nodes}
+        self.part_failure: Optional[str] = None  # terminal part loss (see
+        #   on_part_result): surfaces as the job's error if it ends unresolved
         self.finalized = False
         self.lock = threading.Lock()
         threading.Thread(
@@ -222,37 +224,50 @@ class _Exec:
 
     def on_part_result(self, part_uuid: str, msg: dict) -> None:
         if msg.get("error") and not msg.get("solved") and not msg.get("unsat"):
-            # A FAILED execution, not an exhaustion verdict: the peer's
+            # A FAILED execution, not an exhaustion verdict: the executor's
             # engine drained the part during shutdown, or its flight could
             # not launch (any no-verdict error qualifies — keying on one
             # error string would let other failures mark the part done,
             # free the recovery rows, and leave the subtree silently
             # unsearched; the SOLUTION-path twin of this hole lost a whole
-            # job in the round-4 device-backed churn soak).  Re-enter the
-            # retained rows locally right away — waiting for view-change
-            # recovery would hang forever when the peer stays in the view
-            # (engine restarted, node alive).  If re-entry itself fails,
-            # clear the flag so deadline/view recovery retries later.
+            # job in the round-4 device-backed churn soak).
+            if msg.get("local"):
+                # Our own local re-entry failed — the last resort.  Mark
+                # the part failed-done (exhaustion can never compose into
+                # an unsat proof now) and remember the error so an
+                # unresolved job surfaces it instead of hanging or looping:
+                # re-entering again would fail identically forever.
+                with self.lock:
+                    info = self.parts.get(part_uuid)
+                    if info is None or info["done"]:
+                        return
+                    info["done"] = True
+                    info["rows"] = None
+                    info["exhausted"] = False
+                    self.part_failure = (
+                        f"part {part_uuid} failed on its last-resort local "
+                        f"re-entry: {msg['error']}"
+                    )
+                self._maybe_finalize()
+                return
+            # Remote failure: re-enter the retained rows locally right away
+            # — waiting for view-change recovery would hang forever when
+            # the peer stays in the view (engine restarted, node alive).
+            # An already-rehomed part is left alone (a local re-entry owns
+            # it; this is the original executor's late drain).  If re-entry
+            # raises synchronously, the helper clears the flag so
+            # deadline/view recovery retries later.
             with self.lock:
                 info = self.parts.get(part_uuid)
                 if info is None or info["done"] or self.finalized:
+                    return
+                if info["rehomed"]:
                     return
                 rows_packed, cfg = info["rows"], info["config"]
                 if rows_packed is None:
                     return  # nothing retained; view-change recovery owns it
                 info["rehomed"] = True
-            try:
-                self.node._on_subtask(
-                    {
-                        "part": part_uuid,
-                        "root": self.uuid,
-                        "rows": rows_packed,
-                        "config": cfg,
-                        "report_to": self.node.addr_s,
-                    }
-                )
-            except Exception:  # noqa: BLE001 - e.g. our own engine stopping
-                self.unmark_rehomed(part_uuid)
+            self.node._reenter_part(self, part_uuid, rows_packed, cfg)
             return
         with self.lock:
             info = self.parts.get(part_uuid)
@@ -301,7 +316,15 @@ class _Exec:
             if any(not p["done"] for p in self.parts.values()):
                 return  # exhausted locally, but shipped subtrees still out
             all_parts_exhausted = all(p["exhausted"] for p in self.parts.values())
-        self._finalize(unsat=job.exhausted and all_parts_exhausted)
+            part_failure = self.part_failure
+        unsat = job.exhausted and all_parts_exhausted
+        if not unsat and part_failure:
+            # A part's subtree was lost terminally (remote AND local
+            # executions failed): the inconclusive outcome carries the
+            # cause instead of reading like a mere budget exhaustion.
+            self._finalize(error=part_failure)
+            return
+        self._finalize(unsat=unsat)
 
     def _finalize(
         self,
@@ -1023,6 +1046,11 @@ class ClusterNode:
                 else None,
             }
             if report_to == self.addr_s:
+                # Tag self-reported results: a no-verdict error from a LOCAL
+                # execution is terminal for the part (last resort failed),
+                # where the same error from a remote executor triggers local
+                # re-entry — on_part_result branches on this.
+                payload["local"] = True
                 self._on_part_result(payload)
                 return
             try:
@@ -1057,24 +1085,28 @@ class ClusterNode:
             for part_uuid, rows_packed, cfg in ex.take_orphaned(
                 live, self.config.part_deadline_s
             ):
-                try:
-                    self._on_subtask(
-                        {
-                            "part": part_uuid,
-                            "root": ex.uuid,
-                            "rows": rows_packed,
-                            "config": cfg,
-                            "report_to": self.addr_s,
-                        }
-                    )
-                except Exception as e:
-                    # Re-entry can raise (e.g. "engine stopped" mid-drain).
-                    # Clear the re-homed flag so a later pass retries, and
-                    # never let the raise kill the caller (_hb_loop would
-                    # stop heartbeating entirely).
-                    ex.unmark_rehomed(part_uuid)
-                    if not self._stop.is_set():
-                        print(f"[{self.addr_s}] part re-entry failed: {e!r}")
+                self._reenter_part(ex, part_uuid, rows_packed, cfg)
+
+    def _reenter_part(self, ex: "_Exec", part_uuid: str, rows_packed, cfg) -> None:
+        """Run a previously-shed part locally (recovery: its executor died,
+        blew the deadline, or reported a no-verdict failure).  The caller
+        must have marked the part re-homed; a synchronous re-entry failure
+        clears the flag so a later recovery pass retries — and never kills
+        the caller (a raise in _hb_loop would stop heartbeating entirely)."""
+        try:
+            self._on_subtask(
+                {
+                    "part": part_uuid,
+                    "root": ex.uuid,
+                    "rows": rows_packed,
+                    "config": cfg,
+                    "report_to": self.addr_s,
+                }
+            )
+        except Exception as e:  # noqa: BLE001 - e.g. our own engine stopping
+            ex.unmark_rehomed(part_uuid)
+            if not self._stop.is_set():
+                print(f"[{self.addr_s}] part re-entry failed: {e!r}")
 
     def _on_part_result(self, msg: dict) -> None:
         with self._lock:

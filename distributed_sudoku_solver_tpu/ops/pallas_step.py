@@ -77,47 +77,48 @@ _OUT_ROWS = 8
 _BIG = 2**30
 
 
-def _per_lane_bytes(n: int, stack_slots: int) -> int:
-    # +9 counts the non-stack carries: top, first-solution capture, and the
-    # seven cell-uniform per-lane counters (incl. the enumeration counter).
-    return (stack_slots + 9) * n * n * 4
+def _max_slots(n: int, whole_array: bool) -> int:
+    """Deepest stack the kernel compiles at this geometry (measured, v5e).
 
+    The binding scoped-VMEM constraint is STACK DEPTH, not total bytes:
+    the slot push/pop are static-S masked concat trees whose Mosaic
+    temporaries scale with S x n^2 x tile — a byte-budget model
+    mispredicted in both directions (9x9 S=32 on a whole-array 128-lane
+    tile compiles at 1.7 MB carried while 16x16 S=32 on a 32-lane tile
+    OOMs at 1.3 MB).  Round-4 compile-probe boundaries
+    (``benchmarks``-style minimized probes, gridded = multi-tile
+    ``pallas_call`` whose block pipeline double-buffers):
 
-def _vmem_budget(n: int) -> int:
-    """Carried-state budget (bytes) for one kernel tile, by geometry.
-
-    Mosaic temporaries (fixpoint intermediates, concat trees) consume a
-    geometry-dependent multiple of the carried state on top of it inside
-    the 16 MB scoped limit, so one global constant mispredicts: the budget
-    is calibrated against measured 128-lane-tile compiles on v5e
-    (round 4): 9x9 S=24 compiles (1.37 MB carried), S=28 OOMs (1.53 MB);
-    16x16 S=12 compiles (2.75 MB), S=16 OOMs (3.28 MB).  The multiplier
-    SHRINKS with n (~11x at 9x9, ~5.3x at 16x16), so interpolating to
-    unmeasured geometries (13 <= n <= 15) could admit configs past the
-    edge — those return 0 (fused unavailable) until measured.  Known
-    conservatism: the 9x9-calibrated constant also governs 10 <= n <= 12,
-    where the shrinking multiplier suggests deeper stacks would fit
-    (e.g. 12x12 S=12 at 1.55 MB is rejected but very likely compiles) —
-    admitting them needs a measured compile probe, not a trend guess
-    (ROADMAP r4 note).
+    * 4x4:   whole-array S=64 ok (the TPU-lane 288-grid enumeration)
+    * 9x9:   gridded S=24 ok / S=28 OOM;  whole-array S=48 ok (cap there)
+    * 12x12: gridded S=16 ok / S=20 OOM;  whole-array unprobed -> use the
+      gridded cap as a safe floor (a single resident tile is strictly
+      easier than a double-buffered stream of them)
+    * 16x16: gridded S=12 ok / S=16 OOM;  whole-array S=20 ok / S=24 OOM
+    * 10/11, 13-15, 25: unmeasured / never fits -> 16 (between the 9 and
+      12 calibrations, conservative) / 0 / 0
     """
+    if n <= 6:
+        return 64 if whole_array else 24
+    if n <= 9:
+        return 48 if whole_array else 24
     if n <= 12:
-        return 1_400_000
+        return 16
     if n == 16:
-        return 2_800_000
-    return 0  # unmeasured geometry: no calibration point, no admission
+        return 20 if whole_array else 12
+    return 0  # unmeasured or unfittable geometry: no admission
 
 
 def fused_tile(n: int, stack_slots: int) -> int:
-    """128 if a 128-lane tile's working set fits scoped VMEM, else 0.
+    """128 if a 128-lane (gridded) tile compiles at this geometry/stack,
+    else 0.
 
     Mosaic requires the block's lane dimension to be a multiple of 128 (or
     equal to the whole array), so 128 is the ONLY viable tile width once
-    lanes exceed 128 — there is no "shrink the tile" escape hatch.  0
-    means the fused path cannot run at this (n, stack_slots) beyond 128
-    lanes; see :func:`_vmem_budget` for the measured calibration.
+    lanes exceed 128 — there is no "shrink the tile" escape hatch.  See
+    :func:`_max_slots` for the measured compile boundaries.
     """
-    return 128 if 128 * _per_lane_bytes(n, stack_slots) <= _vmem_budget(n) else 0
+    return 128 if stack_slots <= _max_slots(n, whole_array=False) else 0
 
 
 def _bcast_reduce(x: jax.Array, axis: int, comb) -> jax.Array:
@@ -599,25 +600,25 @@ def fused_lanes(n_lanes: int, n: int, stack_slots: int) -> int:
     Mosaic accepts a lane-tile that is either the whole array (any width
     <= 128 here) or a multiple of 128 (:func:`fused_tile`), so beyond 128
     lanes the count rounds up to the next multiple of 128.  Either way the
-    tile's working set must fit the scoped-VMEM carried-state budget — a
-    static property of ``(n, stack_slots, tile width)`` — so an unfittable
-    config raises HERE, a clean launch-time error, not an opaque Mosaic
-    compile failure at first dispatch (a <=128-lane whole-array tile on a
-    giant board can overflow just as surely as the 128-tile: 25x25 at
-    S=64 is ~182 KB/lane)."""
+    stack depth must sit inside the measured compile boundary for the
+    tile shape (:func:`_max_slots`), so an unfittable config raises HERE
+    — a clean launch-time error, not an opaque Mosaic scoped-VMEM compile
+    failure at first dispatch."""
     if n_lanes <= 128:
-        if n_lanes * _per_lane_bytes(n, stack_slots) > _vmem_budget(n):
+        if stack_slots > _max_slots(n, whole_array=True):
             raise ValueError(
                 f"step_impl='fused' would overflow scoped VMEM at n={n}, "
-                f"stack_slots={stack_slots}, lanes={n_lanes} (whole-array "
-                f"tile); use step_impl='xla' or a shallower stack"
+                f"stack_slots={stack_slots} (whole-array tile compiles to "
+                f"S={_max_slots(n, True)}); use step_impl='xla' or a "
+                f"shallower stack"
             )
         return n_lanes
     if fused_tile(n, stack_slots) == 0:
         raise ValueError(
             f"step_impl='fused' would overflow scoped VMEM at n={n}, "
-            f"stack_slots={stack_slots} beyond 128 lanes (see fused_tile); "
-            f"use step_impl='xla' or a shallower stack"
+            f"stack_slots={stack_slots} beyond 128 lanes (128-lane tile "
+            f"compiles to S={_max_slots(n, False)}); use step_impl='xla' "
+            f"or a shallower stack"
         )
     return -(-n_lanes // 128) * 128
 

@@ -30,16 +30,15 @@ from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
 #: worst case; the reference-order racer adds cell-choice diversity; the
 #: fused racer (round 4 — engine flights accept step_impl='fused') adds a
 #: step-engine axis: it advances rounds ~2.4x faster per chunk where the
-#: geometry + stack fit the kernel's measured VMEM budget (9x9 at these
-#: settings; at 16x16 the 64-lane whole-array tile also passes the
-#: budget — 2.69 MB vs the 2.8 MB n=16 calibration — so the racer runs
-#: there too, in a tile shape near the probed boundary; ROADMAP 4a's
-#: probe session covers it), while the composite racers keep exact
+#: geometry + stack fit the kernel's measured compile boundaries (9x9 at
+#: these settings — whole-array tiles compile to S=48 there; at 16x16
+#: the measured whole-array cap is S=20, so this S=32 racer is excluded
+#: by the launch-time check, matching an observed scoped-VMEM compile
+#: OOM at exactly this shape), while the composite racers keep exact
 #: per-round purge/steal reactivity.  Wherever the kernel cannot serve,
-#: the fused racer's flight fails loudly at launch (or errors at first
-#: dispatch in near-boundary territory) and the OTHER racers decide the
-#: race — an errored racer resolves without a verdict and never blocks a
-#: winner (tests/test_portfolio.py).
+#: the fused racer's flight fails loudly at launch and the OTHER racers
+#: decide the race — an errored racer resolves without a verdict and
+#: never blocks a winner (tests/test_portfolio.py).
 DEFAULT_PORTFOLIO: tuple[SolverConfig, ...] = (
     SolverConfig(branch="minrem"),
     SolverConfig(branch="minrem-desc"),

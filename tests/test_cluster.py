@@ -477,3 +477,43 @@ def test_errored_part_result_never_counts_as_verdict():
     finally:
         a.kill()
         a.engine.stop(timeout=1)
+
+
+def test_errored_local_part_result_is_terminal_not_a_loop():
+    """A no-verdict error from the part's LOCAL re-entry (the last resort)
+    must terminate: the part goes failed-done and an unresolved job
+    surfaces the cause as its error — re-entering again would fail
+    identically forever (an unbounded resubmit loop, caught in review)."""
+    from distributed_sudoku_solver_tpu.cluster.node import _Exec
+    from distributed_sudoku_solver_tpu.serving.engine import Job as EngineJob
+
+    a = make_node()
+    try:
+        g = np.asarray(EASY_9, np.int32)
+        finals: list = []
+        eng_job = EngineJob(uuid="x-term-test", grid=g, geom=a_geom(g))
+        # The local search already exhausted its (shed-incomplete) space.
+        eng_job.exhausted = True
+        ex = _Exec(a, eng_job, on_final=finals.append)
+        assert ex.add_part("p1", "127.0.0.1:2", rows_packed={"d": 1}, config=None)
+        with ex.lock:
+            ex.parts["p1"]["rehomed"] = True  # a re-entry had been attempted
+        ex.on_part_result(
+            "p1",
+            {"solved": False, "unsat": False, "nodes": 0, "local": True,
+             "error": "ValueError: deterministic config failure",
+             "solution": None},
+        )
+        with ex.lock:
+            assert ex.parts["p1"]["done"], "terminal local failure must close the part"
+            assert not ex.parts["p1"]["exhausted"]
+        # The job resolves: error carries the cause, and no unsat claim is
+        # made over the lost subtree.
+        eng_job.done.set()
+        ex._maybe_finalize()
+        assert finals, "aggregate never finalized after terminal part loss"
+        assert finals[0]["error"] and "last-resort" in finals[0]["error"]
+        assert not finals[0]["unsat"] and not finals[0]["solved"]
+    finally:
+        a.kill()
+        a.engine.stop(timeout=1)

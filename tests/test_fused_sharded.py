@@ -64,7 +64,7 @@ def test_fused_ring_steal_spreads_one_hard_job():
     # One job, 8 chips: only the cross-chip ring ppermute can occupy the
     # other 7 chips' lanes (HARD_9[0] needs ~70 branch nodes).
     grids = np.asarray(HARD_9[0])[None]
-    cfg = _cfg(min_lanes=32, stack_slots=64, ring_steal_k=4, fused_steps=2)
+    cfg = _cfg(min_lanes=32, stack_slots=32, ring_steal_k=4, fused_steps=2)
     res = solve_batch_fused_sharded(grids, SUDOKU_9, cfg)
     assert bool(res.solved[0])
     assert int(res.steals) > 0, "no cross-chip (or local) steal ever happened"

@@ -185,13 +185,13 @@ def test_count_all_matches_composite_on_multisolution_9x9():
         jnp.asarray(boards),
         SUDOKU_9,
         SolverConfig(
-            min_lanes=8, stack_slots=64, max_steps=100_000, count_all=True
+            min_lanes=8, stack_slots=32, max_steps=100_000, count_all=True
         ),
     )
     got = solve_batch(
         jnp.asarray(boards),
         SUDOKU_9,
-        _fused(count_all=True, stack_slots=64, max_steps=100_000),
+        _fused(count_all=True, stack_slots=32, max_steps=100_000),
     )
     assert int(got.sol_count[0]) == int(ref.sol_count[0]) == 62
     assert int(got.sol_count[1]) == int(ref.sol_count[1]) == 1

@@ -284,3 +284,22 @@ def test_fused_count_all_on_device():
     )
     assert int(res9.sol_count[0]) == 62
     assert bool(res9.unsat[0])
+
+
+def test_bulk_auto_picks_fused_at_16x16_on_device():
+    """Round 4 widened the bulk auto-gate to any geometry whose tile fits
+    (16x16 at S<=12): the auto path must compile and solve hexadoku with
+    the fused first pass on hardware."""
+    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+    from distributed_sudoku_solver_tpu.utils.puzzles import puzzle_batch
+
+    g16 = geometry_for_size(16)
+    boards = puzzle_batch(
+        g16, 64, seed=9, n_clues=128, unique=False
+    ).astype(np.int32)
+    res = solve_bulk(boards, g16, BulkConfig(chunk=64))  # step_impl=None: auto
+    assert res.solved.all()
+    for i in range(0, 64, 16):
+        assert is_valid_solution(res.solution[i], g16)
