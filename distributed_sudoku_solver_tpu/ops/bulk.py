@@ -82,8 +82,8 @@ class BulkConfig:
     dispatch_steps: int = 512
     rung_stack_mb: int = 768  # cap on a rung's stack tensor (lanes x slots)
     # First-pass step implementation: None = auto ('fused' whole-round VMEM
-    # kernel on TPU, 2.2x the composite step at 65,536 lanes — see
-    # BENCHMARKS.md round 3; 'xla' elsewhere).  Rungs always use the
+    # kernel on TPU, 3.45x the composite step device-only at 65,536 lanes —
+    # see BENCHMARKS.md round 4; 'xla' elsewhere).  Rungs always use the
     # composite step: gang rungs live off steal reaction latency, which the
     # fused path batches at fused_steps granularity.
     step_impl: Optional[str] = None
