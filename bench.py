@@ -67,40 +67,48 @@ def main() -> None:
     solved = int(res.solved.sum())
     boards_per_s = solved / dt
 
-    # Single-puzzle latency on the hardest famous board (warm compile).
+    # Single-puzzle latency on the hardest famous board (warm compile),
+    # interleaved with the RPC-floor and amortized-chain measurements in ONE
+    # loop (VERDICT r4 weak #2: separate loops let tunnel drift between them
+    # exceed the quantity being resolved — BENCH_r04 recorded p50 < floor).
+    # Each iteration samples floor (one trivial dispatch+fetch), then one
+    # solve, then (first 3 iterations) a K-solve back-to-back chain; the
+    # floor min and solve median now share every iteration's tunnel weather,
+    # so floor <= p50 holds unless the tunnel shifts WITHIN an iteration.
+    import jax.numpy as jnp
+
     lat_cfg = SolverConfig(min_lanes=256, stack_slots=64)
     one = np.asarray(HARD_9[0], dtype=np.int32)[None]
     r = solve_batch(one, SUDOKU_9, lat_cfg)
-    int(np.asarray(r.steps))
-    times = []
-    for _ in range(9):
+    int(np.asarray(r.steps))  # warm the solve path
+    tiny = jnp.zeros(8, jnp.int32)
+    _ = np.asarray(tiny + 1)  # warm the trivial dispatch
+    k = 32
+    times, floors = [], []
+    chain_s = float("inf")
+    for i in range(9):
+        t0 = time.perf_counter()
+        _ = np.asarray(tiny + 1)
+        floors.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         r = solve_batch(one, SUDOKU_9, lat_cfg)
         int(np.asarray(r.steps))  # force the value round-trip
         times.append(time.perf_counter() - t0)
+        if i < 3:
+            # Device-only latency (VERDICT r3 #8): K solves dispatched
+            # back-to-back (in-order device execution) cost
+            # floor + K * T_device; subtract the floor and divide.
+            t0 = time.perf_counter()
+            for _ in range(k):
+                r = solve_batch(one, SUDOKU_9, lat_cfg)
+            int(np.asarray(r.steps))  # one sync drains the whole chain
+            chain_s = min(chain_s, time.perf_counter() - t0)
     p50_ms = float(np.median(times)) * 1e3
-
-    # Device-only latency (VERDICT r3 #8): through the tunnel the e2e p50
-    # above is dominated by the ~100 ms RPC floor, so the solver's own
-    # latency is derived by amortization — K solves dispatched back-to-back
-    # (in-order device execution) cost floor + K * T_device, one trivial
-    # dispatch+fetch costs the floor alone; subtract and divide.  Best of 3.
-    import jax.numpy as jnp
-
-    tiny = jnp.zeros(8, jnp.int32)
-    _ = np.asarray(tiny + 1)  # warm the trivial dispatch
-    k = 32
-    floor_s, chain_s = float("inf"), float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        _ = np.asarray(tiny + 1)
-        floor_s = min(floor_s, time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        for _ in range(k):
-            r = solve_batch(one, SUDOKU_9, lat_cfg)
-        int(np.asarray(r.steps))  # one sync drains the whole chain
-        chain_s = min(chain_s, time.perf_counter() - t0)
-    device_ms = max(0.0, (chain_s - floor_s) / k) * 1e3
+    floor_s = min(floors)
+    # Subtract a floor sampled in the SAME iterations the chains ran in
+    # (floors[:3]): the 9-sample min may come from a different
+    # tunnel-weather window, and /k only dilutes, not removes, that drift.
+    device_ms = max(0.0, (chain_s - min(floors[:3])) / k) * 1e3
 
     out = {
         "metric": "hard9x9_bulk_boards_per_s_per_chip",

@@ -121,6 +121,23 @@ def fused_tile(n: int, stack_slots: int) -> int:
     return 128 if stack_slots <= _max_slots(n, whole_array=False) else 0
 
 
+def max_fused_lanes(n: int, stack_slots: int) -> int:
+    """Widest lane count the fused kernel serves at this geometry/stack.
+
+    Three regimes, from the measured compile boundaries (:func:`_max_slots`):
+    unbounded (a 128-lane gridded tile compiles, so any multiple of 128
+    does too), 128 (only the whole-array tile fits — e.g. 9x9 at S=32,
+    where the gridded cap is S=24 but the whole-array cap is S=48), or 0
+    (nothing fits; the caller must fall back to the composite step).  The
+    engine uses this to SPLIT an oversized fused flight group into fitting
+    flights rather than downgrading work the kernel could serve."""
+    if fused_tile(n, stack_slots) > 0:
+        return 1 << 30
+    if stack_slots <= _max_slots(n, whole_array=True):
+        return 128
+    return 0
+
+
 def _bcast_reduce(x: jax.Array, axis: int, comb) -> jax.Array:
     """Reduce ``axis`` to 1, then *materialize* the replication back to the
     input shape with ``_expand`` (a concat of slice copies).

@@ -32,27 +32,19 @@ from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
 #: step-engine axis: it advances rounds ~2.4x faster per chunk where the
 #: geometry + stack fit the kernel's measured compile boundaries (9x9 at
 #: these settings — whole-array tiles compile to S=48 there; at 16x16
-#: the measured whole-array cap is S=20, so this S=32 racer is excluded
-#: by the launch-time check, matching an observed scoped-VMEM compile
-#: OOM at exactly this shape), while the composite racers keep exact
-#: per-round purge/steal reactivity.  Wherever the kernel cannot serve,
-#: the fused racer's flight fails loudly at launch and the OTHER racers
-#: decide the race — an errored racer resolves without a verdict and
-#: never blocks a winner (tests/test_portfolio.py).
+#: the measured whole-array cap is S=20, outside this racer's S=32,
+#: matching an observed scoped-VMEM compile OOM at exactly that shape),
+#: while the composite racers keep exact per-round purge/steal
+#: reactivity.  Wherever the kernel cannot serve,
+#: the engine downgrades the fused racer's flight to the composite step at
+#: launch (counted as ``fused_downgrades`` on ``/metrics``) and it races on
+#: as a fourth composite entrant — never erroring, never blocking a winner
+#: (tests/test_portfolio.py).
 DEFAULT_PORTFOLIO: tuple[SolverConfig, ...] = (
     SolverConfig(branch="minrem"),
     SolverConfig(branch="minrem-desc"),
     SolverConfig(branch="first"),
-    # lanes=64 is a HARD cap, not just a width: the engine groups
-    # same-config jobs into one flight and buckets by batch size, so an
-    # uncapped fused racer under 65+ concurrent races would resolve to a
-    # 128-lane flight whose 128 x (32+9)-row tile overflows the measured
-    # VMEM budget and errors every fused racer in the batch.  With the cap
-    # the engine splits the group into 64-lane flights instead.
-    SolverConfig(
-        branch="minrem", step_impl="fused", fused_steps=4, stack_slots=32,
-        lanes=64,
-    ),
+    SolverConfig(branch="minrem", step_impl="fused", fused_steps=4, stack_slots=32),
 )
 
 

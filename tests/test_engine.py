@@ -380,21 +380,64 @@ def test_fused_snapshot_and_resume_roots():
         slow.stop(timeout=2)
 
 
-def test_fused_flight_vmem_overflow_fails_loudly():
-    """A fused config whose 128-lane kernel tile cannot fit scoped VMEM
-    (16x16 at deep stacks, beyond 128 lanes) must error the job at flight
-    launch — and the loop must keep serving."""
-    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+def test_oversized_fused_group_splits_before_downgrading():
+    """A fused group wider than the kernel's widest serving width SPLITS
+    into fitting fused flights instead of downgrading: 9x9 at S=32 serves
+    whole-array tiles to 128 lanes (gridded 128-lane tiles don't compile),
+    so 130 jobs launch as two fused flights, zero downgrades."""
+    from distributed_sudoku_solver_tpu.ops.pallas_step import max_fused_lanes
 
+    assert max_fused_lanes(9, 32) == 128  # whole-array only
+    assert max_fused_lanes(9, 12) == 1 << 30  # gridded tile fits
+    assert max_fused_lanes(16, 64) == 0  # nothing fits
+    cfg = SolverConfig(stack_slots=32, step_impl="fused", fused_steps=2)
+    eng = SolverEngine(config=cfg, max_batch=256, max_flights=8).start()
+    try:
+        jobs = [eng.submit(EASY_9) for _ in range(130)]
+        for j in jobs:
+            assert j.wait(300), j.error
+            assert j.solved and j.error is None, j.error
+        assert eng.metrics()["fused_downgrades"] == 0
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_pinned_wide_fused_lanes_clamp_to_serving_width():
+    """A fused config pinning lanes above the serving width (9x9 S=32:
+    gridded doesn't compile, whole-array caps at 128) clamps to the cap
+    instead of downgrading — fused at 128 lanes beats composite at 256."""
+    cfg = SolverConfig(lanes=256, stack_slots=32, step_impl="fused", fused_steps=2)
+    eng = SolverEngine(config=cfg, max_batch=8).start()
+    try:
+        j = eng.submit(EASY_9)
+        assert j.wait(300), j.error
+        assert j.solved and j.error is None, j.error
+        assert eng.metrics()["fused_downgrades"] == 0
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_fused_flight_vmem_misfit_downgrades_to_composite():
+    """A fused config whose kernel tile cannot fit scoped VMEM (16x16 at
+    deep stacks, beyond 128 lanes) downgrades the flight to the composite
+    step at launch: the job serves correctly, no error, and the downgrade
+    is counted on /metrics (VERDICT r4 #5 — a correct slower path exists,
+    so a tuning misfit must not error paying jobs)."""
+    from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+    from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
+
+    g16 = geometry_for_size(16)
+    board = make_puzzle(g16, seed=7, n_clues=200, unique=False)  # propagation-easy
     eng = SolverEngine(
         config=SolverConfig(lanes=256, stack_slots=64, step_impl="fused"),
         max_batch=8,
     ).start()
     try:
-        j = eng.submit(np.zeros((16, 16), np.int32), geom=geometry_for_size(16))
-        assert j.wait(60)
-        assert j.error and "VMEM" in j.error, j.error
+        j = eng.submit(np.asarray(board, np.int32), geom=g16)
+        assert j.wait(120), j.error
+        assert j.error is None and j.solved, j.error
+        assert eng.metrics()["fused_downgrades"] >= 1
         ok = eng.submit(EASY_9, config=SMALL)
-        assert ok.wait(60) and ok.solved, "loop died after the failed flight"
+        assert ok.wait(60) and ok.solved, "loop died after the downgraded flight"
     finally:
         eng.stop(timeout=2)

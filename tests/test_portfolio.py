@@ -170,10 +170,12 @@ def test_default_portfolio_includes_fused_axis_and_races():
         eng.stop(timeout=2)
 
 
-def test_fused_racer_failure_never_blocks_the_race():
-    """On a geometry the fused kernel cannot serve, the fused racer's
-    flight fails loudly at launch and the composite racers still decide
-    the race (the docstring contract on DEFAULT_PORTFOLIO)."""
+def test_fused_racer_misfit_downgrades_and_still_races():
+    """On a geometry the fused kernel cannot serve, the engine downgrades
+    the fused racer's flight to the composite step at launch — the racer
+    serves correctly (no errored jobs) and the downgrade is recorded on
+    the engine's metrics (VERDICT r4 #5; the docstring contract on
+    DEFAULT_PORTFOLIO)."""
     from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
     from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
 
@@ -183,15 +185,16 @@ def test_fused_racer_failure_never_blocks_the_race():
         SolverConfig(min_lanes=4, stack_slots=16, max_steps=4096),
         SolverConfig(
             min_lanes=4, stack_slots=16, max_steps=4096, step_impl="fused"
-        ),  # 25x25: no VMEM calibration point -> flight launch raises
+        ),  # 25x25: no VMEM calibration point -> downgraded at launch
     ]
     eng = SolverEngine(max_flights=8).start()
     try:
         res = race(eng, np.asarray(board, np.int32), configs, timeout=300)
         assert res.winner is not None and res.winner.solved
-        assert res.winner_index == 0
         fused_job = res.jobs[1]
-        assert fused_job.wait(30)
-        assert fused_job.error and "VMEM" in fused_job.error
+        assert fused_job.wait(60)
+        assert fused_job.error is None  # downgraded, not errored
+        assert fused_job.solved or fused_job.cancelled
+        assert eng.metrics()["fused_downgrades"] >= 1
     finally:
         eng.stop(timeout=2)
