@@ -1,0 +1,359 @@
+"""Pipeline anatomy: decompose the end-to-end bulk pass stage by stage.
+
+VERDICT r4 missing #1: the single-board latency path has a measured RPC
+floor and a floor-subtracted device number, but the bulk path's ~3x gap
+between device-only (304k boards/s) and end-to-end (145k) had no
+accounting.  This probe produces the decomposition: measured link rates
+(up/down/duplex), the per-dispatch floor, host pack/unpack walls, the
+device-resident compute wall for the exact first-pass config, the
+pipelined first-pass wall with `solve_bulk(trace=...)` attribution, and
+the rung escalation wall with dispatch counts.  The model
+
+    e2e_floor = max(transfer_up + transfer_down [link-serialized],
+                    device_compute) + pipeline fill/drain + rung wall
+
+is then compared against the measured e2e wall so the slack — the only
+part any lever can recover — is a number, not a narrative.
+
+Subcommands (one JSON line per finding, BENCHMARKS.md records adopted
+numbers in "Pipeline anatomy (round 5)"):
+
+  floor   — trivial dispatch+fetch round-trip floor
+  link    — upload/download MB/s at several sizes + duplex overlap probe
+  stages  — full decomposition of the bench.py headline pass (65,536
+            distinct boards) via solve_bulk(trace=...) + device-resident
+            and transfer-only controls
+  sweep   — chunk x inflight grid on the full e2e pass (the r2-tuned
+            32768x3 predates the 3.45x-faster fused first pass)
+  fsteps  — fused_steps 8 vs 32 e2e A/B at the sweep-winning shape
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def emit(**kw) -> None:
+    print(json.dumps(kw), flush=True)
+
+
+def _floor_samples(k: int = 12) -> list[float]:
+    import jax.numpy as jnp
+
+    tiny = jnp.zeros(8, jnp.int32)
+    _ = np.asarray(tiny + 1)  # warm
+    out = []
+    for _ in range(k):
+        t0 = time.perf_counter()
+        _ = np.asarray(tiny + 1)
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+def bench_floor() -> None:
+    f = _floor_samples()
+    emit(
+        metric="rpc_floor_ms",
+        min=round(min(f) * 1e3, 2),
+        p50=round(float(np.median(f)) * 1e3, 2),
+        max=round(max(f) * 1e3, 2),
+    )
+
+
+def _upload(host_arr: np.ndarray) -> float:
+    """Wall to move host bytes onto the device (scalar fetch proves arrival)."""
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    dev = jnp.asarray(host_arr)
+    _ = np.asarray(dev[0])  # blocks until the upload has landed
+    return time.perf_counter() - t0
+
+
+def _download(dev_arr) -> float:
+    t0 = time.perf_counter()
+    _ = np.asarray(dev_arr)
+    return time.perf_counter() - t0
+
+
+def bench_link() -> None:
+    import jax.numpy as jnp
+
+    floor = min(_floor_samples(6))
+    for mb in (1, 4, 16):
+        nbytes = mb << 20
+        host = np.random.default_rng(0).integers(
+            0, 255, nbytes, dtype=np.uint8
+        )
+        ups, downs = [], []
+        dev = jnp.asarray(host)
+        _ = np.asarray(dev[0])
+        for _ in range(4):
+            ups.append(_upload(host))
+            # Re-materialize so the fetch can't be served by a host cache.
+            dev = (dev + 1).astype(jnp.uint8)
+            _ = np.asarray(dev[0])  # compute done; timing below is pure fetch
+            downs.append(_download(dev))
+        up, down = min(ups), min(downs)
+        emit(
+            metric="link_rate",
+            mb=mb,
+            up_s=round(up, 3),
+            down_s=round(down, 3),
+            up_mb_s=round(nbytes / (up - floor) / 1e6, 1),
+            down_mb_s=round(nbytes / (down - floor) / 1e6, 1),
+            floor_ms=round(floor * 1e3, 1),
+        )
+
+    # Duplex probe: do a 4 MB upload and a 4 MB download overlap, or does
+    # the tunnel serialize them?  Two threads, shared start barrier.
+    nbytes = 4 << 20
+    host = np.random.default_rng(1).integers(0, 255, nbytes, dtype=np.uint8)
+    dev = (jnp.asarray(host) + 1).astype(jnp.uint8)
+    _ = np.asarray(dev[0])
+    serial = _upload(host) + _download(dev)
+    walls = {}
+    barrier = threading.Barrier(2)
+
+    def run(name, fn, arg):
+        barrier.wait()
+        t0 = time.perf_counter()
+        fn(arg)
+        walls[name] = time.perf_counter() - t0
+
+    best_overlap = float("inf")
+    for _ in range(3):
+        t1 = threading.Thread(target=run, args=("up", _upload, host))
+        t2 = threading.Thread(target=run, args=("down", _download, dev))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        best_overlap = min(best_overlap, max(walls.values()))
+    emit(
+        metric="duplex",
+        mb=4,
+        serial_s=round(serial, 3),
+        overlapped_s=round(best_overlap, 3),
+        overlap_gain=round(serial / best_overlap, 2),
+    )
+
+
+def _headline_corpus(b: int = 65536) -> np.ndarray:
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, puzzle_batch
+
+    distinct = puzzle_batch(SUDOKU_9, b - len(HARD_9), seed=7, n_clues=24)
+    return np.concatenate([np.stack(HARD_9), distinct]).astype(np.int32)
+
+
+def bench_stages(b: int = 65536) -> None:
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops import wire
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch_wire
+
+    grids = _headline_corpus(b)
+    cfg = BulkConfig()
+    chunk = cfg.chunk
+    floor = min(_floor_samples(6))
+
+    # --- host-only stages -------------------------------------------------
+    t0 = time.perf_counter()
+    packed_chunks = [
+        wire.pack_grids_host(grids[lo : lo + chunk], SUDOKU_9)
+        for lo in range(0, b, chunk)
+    ]
+    pack_s = time.perf_counter() - t0
+    up_bytes = sum(p.nbytes for p in packed_chunks)
+
+    res_shape = (chunk, wire.grid_wire_width(SUDOKU_9) + 1)
+    dummy = np.zeros(res_shape, np.uint8)
+    t0 = time.perf_counter()
+    for _ in packed_chunks:
+        wire.unpack_result_host(dummy, SUDOKU_9)
+    unpack_s = time.perf_counter() - t0
+    down_bytes = dummy.nbytes * len(packed_chunks)
+
+    # --- transfer-only: same bytes, no compute ----------------------------
+    up_s = min(
+        sum(_upload(p) for p in packed_chunks) - floor * len(packed_chunks)
+        for _ in range(3)
+    )
+    dev_res = [(jnp.asarray(dummy) + 0) for _ in packed_chunks]
+    for d in dev_res:
+        _ = np.asarray(d[0, 0])
+    down_s = min(
+        sum(_download(d) for d in dev_res) - floor * len(dev_res)
+        for _ in range(3)
+    )
+
+    # --- device-resident compute: the exact first-pass config -------------
+    first_cfg = SolverConfig(
+        lanes=chunk,
+        stack_slots=cfg.stack_slots,
+        max_steps=min(cfg.first_pass_steps, cfg.max_steps),
+        max_sweeps=cfg.max_sweeps,
+        propagator="slices",
+        rules=cfg.rules,
+        step_impl="fused",
+    )
+    resident = [jnp.asarray(p) for p in packed_chunks]
+    for r in resident:
+        _ = np.asarray(r[0, 0])
+    _ = np.asarray(solve_batch_wire(resident[0], SUDOKU_9, first_cfg)[0, 0])
+    device_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [solve_batch_wire(r, SUDOKU_9, first_cfg) for r in resident]
+        _ = np.asarray(outs[-1][0, 0])  # in-order: one sync drains all
+        device_s = min(device_s, time.perf_counter() - t0 - floor)
+
+    # --- the real pass, attributed ----------------------------------------
+    solve_bulk(grids, SUDOKU_9, cfg)  # warm every rung shape
+    best = {"wall_s": float("inf")}
+    for _ in range(3):
+        trace: dict = {}
+        t0 = time.perf_counter()
+        res = solve_bulk(grids, SUDOKU_9, cfg, trace=trace)
+        wall = time.perf_counter() - t0
+        if wall < best["wall_s"]:
+            best = {"wall_s": wall, "trace": trace, "solved": int(res.solved.sum())}
+
+    trace = best["trace"]
+    rung_s = sum(r["wall_s"] for r in trace["rungs"])
+    rung_dispatches = sum(r["dispatches"] for r in trace["rungs"])
+    transfer_serial = up_s + down_s
+    model_floor = max(transfer_serial, device_s) + rung_s + pack_s + unpack_s
+    emit(
+        metric="pipeline_anatomy",
+        boards=b,
+        e2e_wall_s=round(best["wall_s"], 3),
+        e2e_boards_per_s=round(b / best["wall_s"], 1),
+        solved=best["solved"],
+        pack_s=round(pack_s, 3),
+        unpack_s=round(unpack_s, 3),
+        upload_s=round(up_s, 3),
+        download_s=round(down_s, 3),
+        up_bytes=up_bytes,
+        down_bytes=down_bytes,
+        device_first_pass_s=round(device_s, 3),
+        first_pass_wall_s=round(trace["first_pass_s"], 3),
+        first_pass_drain_s=round(trace["drain_s"], 3),
+        first_pass_pack_s=round(trace["pack_s"], 3),
+        remaining_after_first=trace["remaining_after_first"],
+        rung_wall_s=round(rung_s, 3),
+        rung_dispatches=rung_dispatches,
+        rungs=[
+            {k: (round(v, 3) if isinstance(v, float) else v) for k, v in r.items()}
+            for r in trace["rungs"]
+        ],
+        rpc_floor_ms=round(floor * 1e3, 1),
+        model_floor_s=round(model_floor, 3),
+        slack_s=round(best["wall_s"] - model_floor, 3),
+        slack_pct=round(100 * (best["wall_s"] - model_floor) / best["wall_s"], 1),
+    )
+
+
+def bench_sweep(b: int = 65536) -> None:
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+
+    grids = _headline_corpus(b)
+    combos = [
+        (8192, 4), (8192, 8),
+        (16384, 3), (16384, 6),
+        (32768, 2), (32768, 3), (32768, 4),
+        (65536, 1),
+    ]
+    cfgs = {(c, i): BulkConfig(chunk=c, inflight=i) for c, i in combos}
+    for cfg in cfgs.values():
+        solve_bulk(grids[: cfg.chunk * 2], SUDOKU_9, cfg)  # warm shapes
+    best: dict = {}
+    for _ in range(3):
+        for key, cfg in cfgs.items():
+            t0 = time.perf_counter()
+            res = solve_bulk(grids, SUDOKU_9, cfg)
+            dt = time.perf_counter() - t0
+            if dt < best.get(key, (float("inf"),))[0]:
+                best[key] = (dt, int(res.solved.sum()))
+    for (c, i), (dt, solved) in sorted(best.items()):
+        emit(
+            metric="chunk_inflight_sweep",
+            chunk=c,
+            inflight=i,
+            boards_per_s=round(b / dt, 1),
+            wall_s=round(dt, 3),
+            solved=solved,
+        )
+
+
+def bench_fsteps(b: int = 65536, chunk: int = 32768, inflight: int = 3) -> None:
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+
+    grids = _headline_corpus(b)
+    cfgs = {
+        fs: BulkConfig(chunk=chunk, inflight=inflight, fused_steps=fs)
+        for fs in (8, 16, 32)
+    }
+    best: dict = {}
+    for cfg in cfgs.values():
+        solve_bulk(grids[: chunk * 2], SUDOKU_9, cfg)
+    for _ in range(3):
+        for fs, cfg in cfgs.items():
+            t0 = time.perf_counter()
+            res = solve_bulk(grids, SUDOKU_9, cfg)
+            dt = time.perf_counter() - t0
+            if dt < best.get(fs, (float("inf"),))[0]:
+                best[fs] = (dt, int(res.solved.sum()))
+    for fs, (dt, solved) in sorted(best.items()):
+        emit(
+            metric="fused_steps_e2e",
+            fused_steps=fs,
+            chunk=chunk,
+            inflight=inflight,
+            boards_per_s=round(b / dt, 1),
+            wall_s=round(dt, 3),
+            solved=solved,
+        )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "experiments", nargs="*", default=["floor", "link", "stages"]
+    )
+    args = ap.parse_args()
+    os.environ.setdefault(
+        "DSST_PUZZLE_CACHE", os.path.join(REPO, ".cache", "puzzles")
+    )
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".cache", "xla")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    emit(metric="session", device=str(jax.devices()[0].platform))
+    for exp in args.experiments:
+        {
+            "floor": bench_floor,
+            "link": bench_link,
+            "stages": bench_stages,
+            "sweep": bench_sweep,
+            "fsteps": bench_fsteps,
+        }[exp]()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Minimized Mosaic probes for the fused exact-cover kernel (VERDICT r4 #3).
+
+The cover algebra (``models/cover.py``) is gather-heavy on its face —
+``col_rows[col]``, ``elim[row]`` by dynamic per-lane index — and Mosaic
+lowers no dynamic gather.  The kernel design replaces every gather with an
+MXU matmul over 0/1 float32 matrices (f32 is exact for the small integers
+involved), so before building the kernel this probe pins each primitive on
+real v5e hardware, uint16-refutation-grade:
+
+  P1  f32 dot_general inside a kernel: [C, R']@[R', T] and [R', C]@[C, T]
+  P2  bit-unpack via select-matmul + iota shifts: packed uint32[W, T] ->
+      bits int32[R', T]  (word-at-row = sel[R', W] @ halves; per-row shift
+      by broadcasted_iota % 32)
+  P3  bit-pack via weight-matmuls: bits[R', T] -> uint32[W, T]
+      (two [W, R'] @ [R', T] matmuls, 16 bits each, f32-exact)
+  P4  full-axis min over sublanes (keepdims) + ones-matmul
+      re-materialization [R', 1]@[1, T], result used in a `where`
+      condition (the broadcast-provenance trap `_bcast_reduce` documents)
+  P5  while_loop carrying ([D, T] uint32, [S, D, T] uint32, [8, T] int32)
+      with all of the above in the body
+
+Each probe compiles + runs standalone; failures print the Mosaic error so
+the wall (if any) is named precisely.  CPU interpret mode cross-checks the
+algebra before the hardware compile.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+R, W, C, T, S, D = 224, 7, 28, 128, 8, 8  # queens-14-ish geometry
+
+
+def _unpack_consts():
+    """sel [R, W] f32 (row r reads word r//32); shift [R, 1] iota % 32."""
+    sel = np.zeros((R, W), np.float32)
+    sel[np.arange(R), np.arange(R) // 32] = 1.0
+    return sel
+
+
+def _pack_consts():
+    """Weight matrices: packed_lo/hi = Wlo/Whi @ bits, 16 f32-exact bits each."""
+    wlo = np.zeros((W, R), np.float32)
+    whi = np.zeros((W, R), np.float32)
+    r = np.arange(R)
+    bit = r % 32
+    lo = bit < 16
+    wlo[r[lo] // 32, r[lo]] = (1 << bit[lo]).astype(np.float32)
+    whi[r[~lo] // 32, r[~lo]] = (1 << (bit[~lo] - 16)).astype(np.float32)
+    return wlo, whi
+
+
+def unpack_bits(packed_u32, sel_f):
+    """uint32[W, T] -> int32 0/1 [R, T] via matmul + iota shifts."""
+    # Mosaic has no uint32 -> f32 cast (probed); the masked halves fit int32.
+    lo = (packed_u32 & jnp.uint32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
+    hi = (packed_u32 >> jnp.uint32(16)).astype(jnp.int32).astype(jnp.float32)
+    lo_at = jnp.dot(sel_f, lo, preferred_element_type=jnp.float32)
+    hi_at = jnp.dot(sel_f, hi, preferred_element_type=jnp.float32)
+    shift = jax.lax.broadcasted_iota(jnp.int32, (R, T), 0) % 32
+    lo_i = lo_at.astype(jnp.int32)
+    hi_i = hi_at.astype(jnp.int32)
+    return jnp.where(
+        shift < 16,
+        (lo_i >> shift) & 1,
+        (hi_i >> (shift - 16)) & 1,
+    )
+
+
+def pack_bits(bits_i, wlo_f, whi_f):
+    """int32 0/1 [R, T] -> uint32[W, T] via two weight matmuls."""
+    bf = bits_i.astype(jnp.float32)
+    lo = jnp.dot(wlo_f, bf, preferred_element_type=jnp.float32)
+    hi = jnp.dot(whi_f, bf, preferred_element_type=jnp.float32)
+    # f32 -> int32 -> uint32 (no direct f32 -> uint32 cast in Mosaic).
+    return lo.astype(jnp.int32).astype(jnp.uint32) | (
+        hi.astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
+    )
+
+
+def kernel(inc_ref, sel_ref, wlo_ref, whi_ref, packed_ref, meta_ref,
+           stack_ref, out_cnt, out_packed, out_meta, out_stack,
+           *, steps: int):
+    inc = inc_ref[...]          # f32 [R, C] incidence
+    sel = sel_ref[...]          # f32 [R, W]
+    wlo = wlo_ref[...]          # f32 [W, R]
+    whi = whi_ref[...]          # f32 [W, R]
+    packed = packed_ref[...]    # uint32 [W, T] avail
+    meta = meta_ref[...]        # int32 [8, T]
+    stack = stack_ref[...]      # uint32 [S, W, T]
+
+    def body(c):
+        packed, meta, stack, k = c
+        bits = unpack_bits(packed, sel)                      # P2
+        bf = bits.astype(jnp.float32)
+        cnt = jax.lax.dot_general(                           # P1: [C, T]
+            inc, bf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # P4: lowest available row, rematerialized by ones-matmul
+        r_iota = jax.lax.broadcasted_iota(jnp.int32, (R, T), 0)
+        key = jnp.where(bits > 0, r_iota, jnp.int32(1 << 22))
+        rmin = jnp.min(key, axis=0, keepdims=True)           # [1, T]
+        ones = jnp.zeros((R, 1), jnp.float32) + 1.0
+        rmin_rep = jnp.dot(
+            ones, rmin.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        rowsel = jnp.where((r_iota == rmin_rep) & (bits > 0), 1, 0)
+        # conflict via two matmuls: rows sharing a column with rowsel
+        colset = jax.lax.dot_general(                        # [C, T]
+            inc, rowsel.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        conflict = jnp.dot(                                  # [R, T]
+            inc, jnp.minimum(colset, 1.0),
+            preferred_element_type=jnp.float32,
+        )
+        bits = jnp.where((conflict > 0) & (rowsel == 0), 0, bits)
+        new_packed = pack_bits(bits, wlo, whi)               # P3
+        meta = meta + (rmin_rep[0:8] < (1 << 22)).astype(jnp.int32)
+        # Static-slot write tree (the Sudoku kernel's push idiom on [S, W, T])
+        slot = meta[0:1] % S                                 # [1, T]
+        slot_rep = jnp.dot(
+            jnp.zeros((W, 1), jnp.float32) + 1.0,
+            slot.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)                                  # [W, T]
+        stack = jnp.concatenate(
+            [
+                jnp.where((slot_rep == i)[None], packed[None], stack[i : i + 1])
+                for i in range(S)
+            ],
+            axis=0,
+        )
+        return new_packed, meta, stack, k + 1
+
+    packed, meta, stack, _ = jax.lax.while_loop(             # P5
+        lambda c: c[3] < steps, body, (packed, meta, stack, jnp.int32(0))
+    )
+    bits = unpack_bits(packed, sel)
+    cnt = jax.lax.dot_general(
+        inc, bits.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    out_cnt[...] = cnt.astype(jnp.int32)
+    out_packed[...] = packed
+    out_meta[...] = meta
+    out_stack[...] = stack
+
+
+def run(interpret: bool) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(0)
+    inc = (rng.random((R, C)) < 0.1).astype(np.float32)
+    sel = _unpack_consts()
+    wlo, whi = _pack_consts()
+    packed0 = rng.integers(0, 2**32, (W, T), dtype=np.uint32)
+    meta0 = np.zeros((8, T), np.int32)
+    stack0 = np.zeros((S, W, T), np.uint32)
+
+    f = pl.pallas_call(
+        functools.partial(kernel, steps=3),
+        out_shape=(
+            jax.ShapeDtypeStruct((C, T), jnp.int32),
+            jax.ShapeDtypeStruct((W, T), jnp.uint32),
+            jax.ShapeDtypeStruct((8, T), jnp.int32),
+            jax.ShapeDtypeStruct((S, W, T), jnp.uint32),
+        ),
+        interpret=interpret,
+    )
+    out = f(
+        jnp.asarray(inc), jnp.asarray(sel), jnp.asarray(wlo),
+        jnp.asarray(whi), jnp.asarray(packed0), jnp.asarray(meta0),
+        jnp.asarray(stack0),
+    )
+    return tuple(np.asarray(o) for o in out)
+
+
+def main() -> None:
+    import json
+
+    ref = run(interpret=True)
+    try:
+        got = run(interpret=False)
+    except Exception as e:  # noqa: BLE001 — the probe's job is to name the wall
+        print(json.dumps({
+            "metric": "cover_kernel_probe",
+            "compiles": False,
+            "error": str(e)[:2000],
+        }))
+        sys.exit(1)
+    match = all(np.array_equal(a, b) for a, b in zip(ref, got))
+    print(json.dumps({
+        "metric": "cover_kernel_probe",
+        "compiles": True,
+        "bit_exact_vs_interpret": bool(match),
+    }))
+
+
+if __name__ == "__main__":
+    main()

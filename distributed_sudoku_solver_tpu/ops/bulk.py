@@ -87,6 +87,11 @@ class BulkConfig:
     # composite step: gang rungs live off steal reaction latency, which the
     # fused path batches at fused_steps granularity.
     step_impl: Optional[str] = None
+    # Frontier rounds per fused dispatch on the first pass.  None = the
+    # SolverConfig default (8).  The r4 device-resident re-sweep measured
+    # 32 fastest (417k vs 359k boards/s) but e2e through the tunnel was a
+    # wash; benchmarks/anatomy.py re-probes it per surface (VERDICT r4 #1).
+    fused_steps: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.propagator not in (None, "xla", "pallas", "slices"):
@@ -175,6 +180,7 @@ def solve_bulk(
     geom: Geometry,
     config: BulkConfig = BulkConfig(),
     mesh=None,
+    trace: Optional[dict] = None,
 ) -> BulkResult:
     """Solve ``grids`` int[B, n, n] (0 = empty); B may be huge.
 
@@ -185,6 +191,15 @@ def solve_bulk(
     With ``mesh`` (a 1-axis ``jax.sharding.Mesh``), chunks run the sharded
     frontier (`parallel/sharded.py`: ring-``ppermute`` work stealing,
     ``psum`` solution broadcast over ICI) with lanes sharded over the chips.
+
+    With ``trace`` (a dict), per-stage wall clocks are recorded into it:
+    ``pack_s``/``drain_s`` (host pack+upload vs result-fetch wall inside the
+    pipelined first pass — fetch wall includes waiting out device compute),
+    ``first_pass_s``, ``remaining_after_first``, and per-rung dicts under
+    ``rungs`` (wall, dispatch count, survivors in/out).  The first pass is
+    deliberately overlapped, so these attribute *host-observed* wall, not
+    exclusive device time — the honest decomposition protocol lives in
+    ``benchmarks/anatomy.py``.
     """
     grids = np.ascontiguousarray(np.asarray(grids, dtype=np.int32))
     b, n, _ = grids.shape
@@ -247,6 +262,9 @@ def solve_bulk(
             )
             else "xla"
         )
+    fused_kw = (
+        {} if config.fused_steps is None else {"fused_steps": config.fused_steps}
+    )
     first_cfg = SolverConfig(
         lanes=chunk,
         stack_slots=config.stack_slots,
@@ -255,13 +273,22 @@ def solve_bulk(
         propagator=prop,
         rules=config.rules,
         step_impl=step_impl,
+        **fused_kw,
     )
 
+    import time as _time
+
+    stage = {"pack_s": 0.0, "drain_s": 0.0} if trace is not None else None
+
     def drain(lo: int, res) -> None:
+        t0 = _time.perf_counter()
+        fetched = np.asarray(res)
+        if stage is not None:
+            stage["drain_s"] += _time.perf_counter() - t0
         hi = min(lo + chunk, b)
         k = hi - lo
         r_sol, r_solved, r_unsat, r_branched = wire.unpack_result_host(
-            np.asarray(res), geom
+            fetched, geom
         )
         r_sol, r_solved = r_sol[:k], r_solved[:k]
         solution[lo:hi][r_solved] = r_sol[r_solved]
@@ -269,10 +296,15 @@ def solve_bulk(
         unsat[lo:hi] = r_unsat[:k]
         branched[lo:hi] = r_branched[:k]
 
+    t_first = _time.perf_counter()
     pending: list[tuple[int, object]] = []
     for lo in range(0, b, chunk):
         batch = pad_to(grids[lo : lo + chunk], chunk)
-        pending.append((lo, run_chunk(batch, first_cfg)))
+        t0 = _time.perf_counter()
+        res = run_chunk(batch, first_cfg)
+        if stage is not None:
+            stage["pack_s"] += _time.perf_counter() - t0
+        pending.append((lo, res))
         if len(pending) >= max(1, config.inflight):
             drain(*pending.pop(0))
     while pending:
@@ -280,6 +312,13 @@ def solve_bulk(
 
     by_propagation = solved & ~branched
     searched = int(branched.sum())
+    if trace is not None:
+        trace.update(stage)
+        trace["first_pass_s"] = _time.perf_counter() - t_first
+        trace["chunks"] = -(-b // chunk)
+        trace["step_impl"] = step_impl
+        trace["remaining_after_first"] = int((~solved & ~unsat).sum())
+        trace["rungs"] = []
 
     # --- escalation rungs: re-run unresolved stragglers with gangs --------
     # Rungs run *stepped*: bounded-step advances instead of one monolithic
@@ -296,6 +335,7 @@ def solve_bulk(
 
             packed = jnp.asarray(wire.pack_grids_host(batch, geom))
             res = solve_batch_sharded_wire(packed, geom, scfg, mesh)
+            dispatches[0] += 1
             return wire.unpack_result_host(np.asarray(res), geom)
         from distributed_sudoku_solver_tpu.utils.checkpoint import advance_frontier
 
@@ -304,11 +344,14 @@ def solve_bulk(
         while limit < scfg.max_steps:
             limit = min(limit + config.dispatch_steps, scfg.max_steps)
             state = advance_frontier(state, jnp.int32(limit), geom, scfg)
+            dispatches[0] += 1
             if not bool(_any_live(state)):
                 break
         return wire.unpack_result_host(
             np.asarray(_rung_finish(state, geom)), geom
         )
+
+    dispatches = [0]
 
     remaining = np.flatnonzero(~solved & ~unsat)
     rungs = default_rungs(geom) if config.rungs is None else config.rungs
@@ -353,6 +396,8 @@ def solve_bulk(
             steal_rounds=4 if lanes_per_job > 1 else 1,
         )
         still: list[int] = []
+        t_rung = _time.perf_counter()
+        dispatches[0] = 0
         for lo in range(0, len(remaining), jobs_per_chunk):
             idx = remaining[lo : lo + jobs_per_chunk]
             r_sol, r_solved, r_unsat, _ = run_rung_stepped(
@@ -365,6 +410,16 @@ def solve_bulk(
             solved[idx] = r_solved
             unsat[idx] = r_unsat
             still.extend(idx[~r_solved & ~r_unsat])
+        if trace is not None:
+            trace["rungs"].append({
+                "wall_s": _time.perf_counter() - t_rung,
+                "rung": tuple(int(x) for x in rung),
+                "lanes": int(scfg.lanes),
+                "slots": int(scfg.stack_slots),
+                "dispatches": dispatches[0],
+                "survivors_in": len(remaining),
+                "survivors_out": len(still),
+            })
         remaining = np.asarray(still, dtype=remaining.dtype)
 
     return BulkResult(

@@ -510,14 +510,6 @@ class SolverEngine:
     def _launch_flights(
         self, geom: Geometry, cfg: SolverConfig, group: list[Job]
     ) -> None:
-        # Roots jobs (resume / offloaded subtrees) fly solo with *packed*
-        # seeding: their rows deal round-robin onto the configured lane
-        # width, so a resume runs at the same width — and the same
-        # speculative-expansion budget — as the original search.
-        for job in group:
-            if job.roots is not None:
-                self._start_packed_flight(geom, cfg, job)
-        group = [j for j in group if j.roots is None]
         cap = cfg.lanes if cfg.lanes > 0 else self.max_batch
         if cfg.step_impl == "fused":
             # Split the group at the widest width the kernel serves (e.g.
@@ -541,6 +533,16 @@ class SolverEngine:
                         lanes=min(cfg.lanes, mfl) if cfg.lanes > 0 else 0,
                         min_lanes=min(cfg.min_lanes, mfl),
                     )
+        # Roots jobs (resume / offloaded subtrees) fly solo with *packed*
+        # seeding: their rows deal round-robin onto the configured lane
+        # width, so a resume runs at the same width — and the same
+        # speculative-expansion budget — as the original search.  They get
+        # the clamped cfg too: a pinned width above the fused serving cap
+        # should clamp-and-stay-fused for a resume exactly as for grid jobs.
+        for job in group:
+            if job.roots is not None:
+                self._start_packed_flight(geom, cfg, job)
+        group = [j for j in group if j.roots is None]
         for i in range(0, len(group), cap):
             self._start_flight(geom, cfg, group[i : i + cap])
 

@@ -417,6 +417,26 @@ def test_pinned_wide_fused_lanes_clamp_to_serving_width():
         eng.stop(timeout=2)
 
 
+def test_packed_roots_fused_flight_clamps_like_grid_jobs():
+    """A roots (resume) job under the same over-wide fused config clamps to
+    the serving width and stays fused, exactly like a grid job — packed
+    flights must not bypass the clamp and silently downgrade (r5 review)."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+    import jax.numpy as jnp
+
+    cfg = SolverConfig(lanes=256, stack_slots=32, step_impl="fused", fused_steps=2)
+    roots = np.asarray(encode_grid(jnp.asarray(np.asarray(EASY_9)[None]), SUDOKU_9))
+    eng = SolverEngine(config=cfg, max_batch=8).start()
+    try:
+        j = eng.submit_roots(roots, SUDOKU_9)
+        assert j.wait(300), j.error
+        assert j.solved and j.error is None, j.error
+        assert eng.metrics()["fused_downgrades"] == 0
+    finally:
+        eng.stop(timeout=2)
+
+
 def test_fused_flight_vmem_misfit_downgrades_to_composite():
     """A fused config whose kernel tile cannot fit scoped VMEM (16x16 at
     deep stacks, beyond 128 lanes) downgrades the flight to the composite
