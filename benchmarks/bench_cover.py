@@ -44,6 +44,8 @@ def device_count_all(
     Best-of-``repeat`` wall clock — one-shot numbers through the tunneled
     chip are noise (BENCHMARKS.md "Measurement protocol"; a 20x outlier
     was observed on this very workload's sub-second dispatch pattern)."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
@@ -54,9 +56,21 @@ def device_count_all(
     )
     from distributed_sudoku_solver_tpu.ops.solve import finalize_frontier
 
-    @functools.partial(jax.jit, static_argnames=("problem", "config"))
-    def advance(state, limit, problem, config):
-        return run_frontier(state, problem, config, step_limit=limit)
+    if config.step_impl == "fused":
+        from distributed_sudoku_solver_tpu.ops.pallas_cover import (
+            advance_cover_fused,
+            cover_fused_lanes,
+        )
+
+        config = dataclasses.replace(
+            config, lanes=cover_fused_lanes(config.resolve_lanes(1))
+        )
+        advance = advance_cover_fused
+    else:
+
+        @functools.partial(jax.jit, static_argnames=("problem", "config"))
+        def advance(state, limit, problem, config):
+            return run_frontier(state, problem, config, step_limit=limit)
 
     roots = jnp.asarray(problem.initial_state()[None])
     state = init_frontier(roots, config)
@@ -85,11 +99,15 @@ def emit(**kw) -> None:
     print(json.dumps(kw), flush=True)
 
 
-def run_row(name: str, problem, expect: int, config) -> None:
+def run_row(name: str, problem, expect: int, config, fused_config=None) -> None:
     from distributed_sudoku_solver_tpu import native
 
     cnt, nodes, dt = device_count_all(problem, config)
     assert cnt == expect, f"{name}: device counted {cnt}, expected {expect}"
+    f_cnt, f_nodes, f_dt = None, None, None
+    if fused_config is not None:
+        f_cnt, f_nodes, f_dt = device_count_all(problem, fused_config)
+        assert f_cnt == expect, f"{name}: fused counted {f_cnt}, expected {expect}"
     n_cnt, n_nodes, n_dt = None, None, None
     if native.available():
         n_dt = float("inf")
@@ -105,9 +123,15 @@ def run_row(name: str, problem, expect: int, config) -> None:
         solutions=cnt,
         device_s=round(dt, 3),
         device_nodes=nodes,
+        fused_s=round(f_dt, 3) if f_dt is not None else None,
+        fused_nodes=f_nodes,
+        fused_speedup=round(dt / f_dt, 2) if f_dt else None,
         native_s=round(n_dt, 3) if n_dt is not None else None,
         native_nodes=n_nodes,
         speedup_vs_native=round(n_dt / dt, 2) if n_dt else None,
+        fused_speedup_vs_native=(
+            round(n_dt / f_dt, 2) if (n_dt and f_dt) else None
+        ),
     )
 
 
@@ -119,6 +143,10 @@ def main() -> None:
     )
     ap.add_argument("--lanes", type=int, default=4096)  # the BENCHMARKS.md config
     ap.add_argument("--stack-slots", type=int, default=128)
+    ap.add_argument(
+        "--no-fused", action="store_true",
+        help="skip the fused-kernel column (composite + native only)",
+    )
     args = ap.parse_args()
 
     from distributed_sudoku_solver_tpu.models.nqueens import nqueens_cover
@@ -132,6 +160,15 @@ def main() -> None:
         count_all=True,
         steal_rounds=4,  # enumeration is a permanent gang: fan out fast
     )
+    import dataclasses
+
+    # Same lanes/depth/steal on the fused column so the A/B isolates the
+    # step engine (the whole-round VMEM kernel, ops/pallas_cover.py).
+    fused_cfg = (
+        None
+        if args.no_fused
+        else dataclasses.replace(cfg, step_impl="fused")
+    )
     known = {
         "q12": ("nqueens12", nqueens_cover(12), 14_200),
         "q13": ("nqueens13", nqueens_cover(13), 73_712),
@@ -140,7 +177,7 @@ def main() -> None:
     }
     for key in args.rows.split(","):
         name, problem, expect = known[key]
-        run_row(name, problem, expect, cfg)
+        run_row(name, problem, expect, cfg, fused_cfg)
 
 
 if __name__ == "__main__":

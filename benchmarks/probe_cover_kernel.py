@@ -22,6 +22,16 @@ real v5e hardware, uint16-refutation-grade:
 Each probe compiles + runs standalone; failures print the Mosaic error so
 the wall (if any) is named precisely.  CPU interpret mode cross-checks the
 algebra before the hardware compile.
+
+Post-build finding (the kernel's first hardware run caught what this
+probe's original comparison could not): BOTH Mosaic and XLA:TPU compute
+f32 dots at reduced precision by default (bf16 input passes), which
+rounds the 16-bit word values in the unpack matmuls — and because this
+probe compared the real kernel against *interpret mode in the same TPU
+process*, both sides were identically wrong and the comparison passed.
+Every dot now pins ``precision=HIGHEST`` (exact f32), matching
+``ops/pallas_cover.py``, and the checksum row below asserts a known
+value so a same-wrong-both-sides regression cannot slip through again.
 """
 
 from __future__ import annotations
@@ -56,13 +66,20 @@ def _pack_consts():
     return wlo, whi
 
 
+_EXACT = jax.lax.Precision.HIGHEST
+
+
+def _dot(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32, precision=_EXACT)
+
+
 def unpack_bits(packed_u32, sel_f):
     """uint32[W, T] -> int32 0/1 [R, T] via matmul + iota shifts."""
     # Mosaic has no uint32 -> f32 cast (probed); the masked halves fit int32.
     lo = (packed_u32 & jnp.uint32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
     hi = (packed_u32 >> jnp.uint32(16)).astype(jnp.int32).astype(jnp.float32)
-    lo_at = jnp.dot(sel_f, lo, preferred_element_type=jnp.float32)
-    hi_at = jnp.dot(sel_f, hi, preferred_element_type=jnp.float32)
+    lo_at = _dot(sel_f, lo)
+    hi_at = _dot(sel_f, hi)
     shift = jax.lax.broadcasted_iota(jnp.int32, (R, T), 0) % 32
     lo_i = lo_at.astype(jnp.int32)
     hi_i = hi_at.astype(jnp.int32)
@@ -76,8 +93,8 @@ def unpack_bits(packed_u32, sel_f):
 def pack_bits(bits_i, wlo_f, whi_f):
     """int32 0/1 [R, T] -> uint32[W, T] via two weight matmuls."""
     bf = bits_i.astype(jnp.float32)
-    lo = jnp.dot(wlo_f, bf, preferred_element_type=jnp.float32)
-    hi = jnp.dot(whi_f, bf, preferred_element_type=jnp.float32)
+    lo = _dot(wlo_f, bf)
+    hi = _dot(whi_f, bf)
     # f32 -> int32 -> uint32 (no direct f32 -> uint32 cast in Mosaic).
     return lo.astype(jnp.int32).astype(jnp.uint32) | (
         hi.astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
@@ -101,36 +118,28 @@ def kernel(inc_ref, sel_ref, wlo_ref, whi_ref, packed_ref, meta_ref,
         bf = bits.astype(jnp.float32)
         cnt = jax.lax.dot_general(                           # P1: [C, T]
             inc, bf, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_EXACT,
         )
         # P4: lowest available row, rematerialized by ones-matmul
         r_iota = jax.lax.broadcasted_iota(jnp.int32, (R, T), 0)
         key = jnp.where(bits > 0, r_iota, jnp.int32(1 << 22))
         rmin = jnp.min(key, axis=0, keepdims=True)           # [1, T]
         ones = jnp.zeros((R, 1), jnp.float32) + 1.0
-        rmin_rep = jnp.dot(
-            ones, rmin.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32)
+        rmin_rep = _dot(ones, rmin.astype(jnp.float32)).astype(jnp.int32)
         rowsel = jnp.where((r_iota == rmin_rep) & (bits > 0), 1, 0)
         # conflict via two matmuls: rows sharing a column with rowsel
         colset = jax.lax.dot_general(                        # [C, T]
             inc, rowsel.astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_EXACT,
         )
-        conflict = jnp.dot(                                  # [R, T]
-            inc, jnp.minimum(colset, 1.0),
-            preferred_element_type=jnp.float32,
-        )
+        conflict = _dot(inc, jnp.minimum(colset, 1.0))       # [R, T]
         bits = jnp.where((conflict > 0) & (rowsel == 0), 0, bits)
         new_packed = pack_bits(bits, wlo, whi)               # P3
         meta = meta + (rmin_rep[0:8] < (1 << 22)).astype(jnp.int32)
         # Static-slot write tree (the Sudoku kernel's push idiom on [S, W, T])
         slot = meta[0:1] % S                                 # [1, T]
-        slot_rep = jnp.dot(
-            jnp.zeros((W, 1), jnp.float32) + 1.0,
-            slot.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+        slot_rep = _dot(
+            jnp.zeros((W, 1), jnp.float32) + 1.0, slot.astype(jnp.float32)
         ).astype(jnp.int32)                                  # [W, T]
         stack = jnp.concatenate(
             [
@@ -147,7 +156,7 @@ def kernel(inc_ref, sel_ref, wlo_ref, whi_ref, packed_ref, meta_ref,
     bits = unpack_bits(packed, sel)
     cnt = jax.lax.dot_general(
         inc, bits.astype(jnp.float32), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_EXACT,
     )
     out_cnt[...] = cnt.astype(jnp.int32)
     out_packed[...] = packed
@@ -196,10 +205,20 @@ def main() -> None:
         }))
         sys.exit(1)
     match = all(np.array_equal(a, b) for a, b in zip(ref, got))
+    # Backend-independent ground truth: interpret-on-TPU and Mosaic share
+    # the dot-lowering default, so "they agree" alone proves nothing — the
+    # hardware output must ALSO reproduce the value pinned from an exact
+    # (precision=HIGHEST) run, or a reduced-precision regression is loose.
+    checksum = int(got[1].astype(np.uint64).sum() % (1 << 31))
+    assert checksum == 653337268, (
+        f"packed checksum {checksum} != pinned 653337268: a dot in this "
+        "probe (or its lowering) lost exactness — check precision pins"
+    )
     print(json.dumps({
         "metric": "cover_kernel_probe",
         "compiles": True,
         "bit_exact_vs_interpret": bool(match),
+        "packed_checksum": checksum,
     }))
 
 

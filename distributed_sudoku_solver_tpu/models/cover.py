@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -77,12 +78,23 @@ class ExactCoverCSP:
     row_cols: np.ndarray  # uint32[R, W_c]: primary columns covered by each row
     elim: np.ndarray  # uint32[R, W_r]: rows conflicting with row r (r excluded)
     max_sweeps: int = 64
+    # Full incidence (primary + secondary columns), bit-packed [R, ceil(Cf/32)].
+    # The composite kernels never read it (their conflict source is ``elim``);
+    # the fused VMEM kernel (``ops/pallas_cover.py``) derives per-take
+    # conflicts from it as two MXU matmuls instead of an R x R gather.
+    incidence: Optional[np.ndarray] = None
+    n_cols_full: int = 0
 
     def __post_init__(self) -> None:
         h = hashlib.sha256()
         for arr in (self.col_rows, self.row_cols, self.elim):
             h.update(np.ascontiguousarray(arr).tobytes())
         h.update(f"{self.name}:{self.n_rows}:{self.n_primary}:{self.max_sweeps}".encode())
+        if self.incidence is not None:
+            # Distinct secondary-column structure must trace distinctly: the
+            # fused kernel bakes the full incidence into its program.
+            h.update(np.ascontiguousarray(self.incidence).tobytes())
+            h.update(str(self.n_cols_full).encode())
         object.__setattr__(self, "_digest", h.hexdigest())
 
     def __hash__(self) -> int:
@@ -277,6 +289,8 @@ def build_cover(
         row_cols=_pack_bits(a[:, :n_primary]),
         elim=_pack_bits(conflict),
         max_sweeps=max_sweeps,
+        incidence=_pack_bits(a),
+        n_cols_full=a.shape[1],
     )
 
 

@@ -1,0 +1,709 @@
+"""Whole-round fused VMEM kernel for generalized exact cover (VERDICT r4 #3).
+
+``ops/pallas_step.py`` gave the Sudoku family a whole-round kernel; this
+module is the promised second kernel over the packed row-conflict algebra
+of ``models/cover.py`` (n-queens, pentomino, any ``ExactCoverCSP``), so
+the cover family — whose headline workload IS enumeration — gets the same
+dispatch-amortized VMEM treatment that bought Sudoku `count_all` 3.31x.
+
+The cover algebra looks Mosaic-hostile on its face: ``col_rows[col]`` and
+``elim[row]`` are gathers by *dynamic per-lane index*, and Mosaic lowers
+no dynamic gather.  The kernel's central design move is that on TPU every
+one of those gathers is an **MXU matmul** over 0/1 float32 matrices
+(exact for all integers involved, < 2^24):
+
+* per-column candidate counts: ``cnt = inc_primᵀ @ avail``  — [C, T]
+* "rows of the chosen column": ``inc_prim @ colsel``        — [R, T]
+* conflict elimination for a chosen row (replacing the R x R ``elim``
+  matrix, which at pentomino scale would be 17 MB of VMEM):
+  ``colset = inc_fullᵀ @ rowsel`` then ``inc_full @ colset`` — two
+  matmuls through the full incidence (primary + secondary columns)
+* bitmask unpack/pack between the frontier's packed ``uint32[D]`` state
+  and the kernel's unpacked 0/1 row/column tensors: word-select and
+  bit-weight matmuls (16 f32-exact bits per half)
+* one-hot re-materialization of sublane min-reductions (lowest forced
+  column, lowest available row, MRV column): ``ones @ min`` — a matmul
+  materialization with natural layout, sidestepping the
+  broadcast-provenance trap ``pallas_step._bcast_reduce`` documents.
+
+Every primitive above was pinned on real v5e hardware by a minimized
+probe before this module was built (``benchmarks/probe_cover_kernel.py``,
+bit-exact vs interpret mode; two named walls found and routed around:
+Mosaic has no uint32<->f32 cast in either direction, so all casts go
+through int32).
+
+Search semantics mirror the composite engine exactly (``models/cover.py``
+propagate/status/branch: one forced take per lane per sweep, MRV column
+branch, lowest-row guess vs row-exclusion rest), under the same
+fused-round contract as the Sudoku kernel: purge/steal/harvest batch at
+``fused_steps`` granularity in the XLA driver between dispatches
+(``pallas_step._fused_round`` — shared, not duplicated), so node counts
+may differ from the composite step while every verdict stays sound.
+
+Reference bar: SURVEY.md §7.2 step 6 ("N-queens/pentomino on the same
+kernel"); the reference's one kernel (``/root/reference/DHT_Node.py:
+474-538``) was its only engine for everything it could express.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from distributed_sudoku_solver_tpu.models.cover import ExactCoverCSP, _unpack_bits
+from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+    _VMEM,
+    _interpret_default,
+)
+
+_BIG = 1 << 22  # f32-exact sentinel for row/column argmin keys
+
+# meta rows: input state [8, T]; output state + per-dispatch deltas [16, T]
+_HAS, _BASE, _COUNT = 0, 1, 2
+_SOLVED, _OVER, _NODES, _SOLS, _SWEEPS, _STEPS = 3, 4, 5, 6, 7, 8
+
+
+# Rows per in-kernel block.  A compile-boundary sweep on v5e (synthetic
+# instances, S=8, 128 lanes) put the wall between R'=1024 (compiles) and
+# R'=1536 (tpu_compile_helper exit 1) for the UNBLOCKED dataflow — the
+# scoped-VMEM working set scales with the unpacked row tensor, and lane
+# tiles below 128 don't help (the lane dim pads to 128 regardless).  The
+# kernel therefore streams the row space in <= 1024-row word-aligned
+# blocks, keeping ``avail`` packed between passes; instances of any row
+# count compile, paying one extra unpack per pass.
+_BLOCK_WORDS = 32
+
+
+class CoverConsts(NamedTuple):
+    """Per-instance constant matrices the kernel consumes (host numpy).
+
+    The row space is padded to ``n_blocks * _BLOCK_WORDS * 32`` rows so
+    every block shares one selector/weight set; padding rows have all-zero
+    incidence and are never available."""
+
+    inc_full: np.ndarray  # f32[R', C_full] full incidence (primary first)
+    sel_b: np.ndarray  # f32[BR, BW]  word selector for one row block
+    wlo_b: np.ndarray  # f32[BW, BR]  pack weights, bits 0-15
+    whi_b: np.ndarray  # f32[BW, BR]  pack weights, bits 16-31
+    sel_c: np.ndarray  # f32[C', W_c]
+    wlo_c: np.ndarray  # f32[W_c, C']
+    whi_c: np.ndarray  # f32[W_c, C']
+
+
+def _sel_weights(w: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    kp = w * 32
+    sel = np.zeros((kp, w), np.float32)
+    sel[np.arange(kp), np.arange(kp) // 32] = 1.0
+    wlo = np.zeros((w, kp), np.float32)
+    whi = np.zeros((w, kp), np.float32)
+    r = np.arange(kp)
+    bit = r % 32
+    lo = bit < 16
+    wlo[r[lo] // 32, r[lo]] = (1 << bit[lo]).astype(np.float32)
+    whi[r[~lo] // 32, r[~lo]] = (1 << (bit[~lo] - 16)).astype(np.float32)
+    return sel, wlo, whi
+
+
+def cover_block_words(problem: ExactCoverCSP) -> int:
+    """Words per row block: whole packed width when it fits one block."""
+    return min(_BLOCK_WORDS, problem.w_rows)
+
+
+@functools.lru_cache(maxsize=32)
+def cover_consts(problem: ExactCoverCSP) -> CoverConsts:
+    if problem.incidence is None:
+        raise ValueError(
+            "fused cover kernel needs the full incidence matrix; rebuild the "
+            "instance via models.cover.build_cover (older pickles lack it)"
+        )
+    inc = _unpack_bits(
+        problem.incidence, problem.n_cols_full
+    ).astype(np.float32)  # [R, C_full]
+    bw = cover_block_words(problem)
+    n_blocks = -(-problem.w_rows // bw)
+    r_pad = n_blocks * bw * 32
+    inc_full = np.zeros((r_pad, inc.shape[1]), np.float32)
+    inc_full[: inc.shape[0]] = inc
+    sel_b, wlo_b, whi_b = _sel_weights(bw)
+    sel_c, wlo_c, whi_c = _sel_weights(problem.w_cols)
+    # covered unpacks to c_pad = w_cols*32 rows; rows beyond n_primary
+    # unpack pad bits that are always zero — harmless.
+    return CoverConsts(
+        inc_full=inc_full,
+        sel_b=sel_b,
+        wlo_b=wlo_b,
+        whi_b=whi_b,
+        sel_c=sel_c,
+        wlo_c=wlo_c,
+        whi_c=whi_c,
+    )
+
+
+def _f32(x_i: jax.Array) -> jax.Array:
+    return x_i.astype(jnp.float32)
+
+
+# XLA:TPU computes f32 dots at reduced precision by default (bf16 input
+# passes, 8-bit mantissa): the 16-bit word values flowing through the
+# unpack matmuls round to garbage — observed as a spurious "forced" take
+# on the 6-queens root in interpret mode on the TPU backend while the
+# identical program is exact on CPU.  HIGHEST forces exact f32 products;
+# every integer here is < 2^24 so f32 accumulation is exact.
+_EXACT = jax.lax.Precision.HIGHEST
+
+
+def _dot(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32, precision=_EXACT)
+
+
+def _unpack(packed_u32, sel_f):
+    """uint32[W, T] -> int32 0/1 [W*32, T] (word-select matmul + iota shift).
+
+    Casts route through int32: Mosaic has no uint32 -> f32 cast (probed)."""
+    k = sel_f.shape[0]
+    lo = (packed_u32 & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    hi = (packed_u32 >> jnp.uint32(16)).astype(jnp.int32)
+    lo_at = _dot(sel_f, _f32(lo))
+    hi_at = _dot(sel_f, _f32(hi))
+    shift = jax.lax.broadcasted_iota(jnp.int32, (k, packed_u32.shape[-1]), 0) % 32
+    lo_i = lo_at.astype(jnp.int32)
+    hi_i = hi_at.astype(jnp.int32)
+    return jnp.where(shift < 16, (lo_i >> shift) & 1, (hi_i >> (shift - 16)) & 1)
+
+
+def _pack(bits_i, wlo_f, whi_f):
+    """int32 0/1 [W*32, T] -> uint32[W, T] (two 16-bit weight matmuls)."""
+    bf = _f32(bits_i)
+    lo = _dot(wlo_f, bf)
+    hi = _dot(whi_f, bf)
+    return lo.astype(jnp.int32).astype(jnp.uint32) | (
+        hi.astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
+    )
+
+
+def _rep(row_1t: jax.Array, k: int) -> jax.Array:
+    """int32[1, T] -> int32[k, T] via ones-matmul (values must be < 2^24)."""
+    ones = jnp.zeros((k, 1), jnp.float32) + 1.0
+    return _dot(ones, _f32(row_1t)).astype(jnp.int32)
+
+
+def _contract_rows(mat_f, x_i):
+    """f32[K, C] x int32[K, T] -> int32[C, T]: contract the leading axis."""
+    return jax.lax.dot_general(
+        mat_f, _f32(x_i), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=_EXACT,
+    ).astype(jnp.int32)
+
+
+def _matvec(mat_f, x_i):
+    """f32[K, C] x int32[C, T] -> int32[K, T]."""
+    return _dot(mat_f, _f32(x_i)).astype(jnp.int32)
+
+
+def _cover_kernel(
+    inc_f_ref, sel_b_ref, wlo_b_ref, whi_b_ref,
+    sel_c_ref, wlo_c_ref, whi_c_ref,
+    top_ref, stack_ref, meta_ref,
+    out_top, out_stack, out_sol, out_meta,
+    *,
+    n_primary: int,
+    w_rows: int,
+    max_sweeps: int,
+    k_steps: int,
+    count_mode: bool,
+):
+    """Up to ``k_steps`` whole cover rounds for one VMEM lane tile.
+
+    State layout: top/stack rows are the frontier's packed cover state
+    (``models/cover.py``: W_r avail words then W_c covered words); per-lane
+    scalars ride distinct rows of the int32 ``meta`` block (loop-carried
+    [8, T] / [16, T] blocks legalize — probed — unlike [1, T] carries).
+
+    The row space streams in word-aligned blocks (``_BLOCK_WORDS``):
+    ``avail`` stays PACKED between passes and each pass unpacks one
+    <= 1024-row block at a time — the unblocked dataflow's [R', T] working
+    set hits the scoped-VMEM compile wall between R' = 1024 and 1536."""
+    inc_f = inc_f_ref[...]  # f32[R', C_full]
+    sel_b = sel_b_ref[...]  # f32[BR, BW]
+    wlo_b = wlo_b_ref[...]
+    whi_b = whi_b_ref[...]
+    sel_c = sel_c_ref[...]
+    wlo_c = wlo_c_ref[...]
+    whi_c = whi_c_ref[...]
+    top = top_ref[...]  # uint32[D, T]
+    stack = stack_ref[...]  # uint32[S, D, T]
+    meta_in = meta_ref[...]  # int32[8, T]
+
+    t = top.shape[-1]
+    s = stack.shape[0]
+    br, bw = sel_b.shape[0], sel_b.shape[1]
+    r_pad = inc_f.shape[0]
+    n_blocks = r_pad // br
+    w_pad = n_blocks * bw
+    c_pad = sel_c.shape[0]
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (c_pad, t), 0)
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (br, t), 0)
+    prim = (c_iota < n_primary).astype(jnp.int32)
+
+    def split(packed):
+        return packed[:w_rows], packed[w_rows:]
+
+    def pad_words(ap):
+        if w_pad == w_rows:
+            return ap
+        return jnp.concatenate(
+            [ap, jnp.zeros((w_pad - w_rows, t), jnp.uint32)], axis=0
+        )
+
+    def inc_blk(b):
+        return inc_f[b * br : (b + 1) * br]
+
+    def bits_blk(ap, b):
+        return _unpack(ap[b * bw : (b + 1) * bw], sel_b)  # int32 0/1 [BR, T]
+
+    def pad_cols(x):
+        if x.shape[0] == c_pad:
+            return x
+        return jnp.concatenate(
+            [x, jnp.zeros((c_pad - x.shape[0], t), jnp.int32)], axis=0
+        )
+
+    def counts(ap, covered):
+        cnt = jnp.zeros((n_primary, t), jnp.int32)
+        for b in range(n_blocks):
+            cnt = cnt + _contract_rows(
+                inc_blk(b)[:, :n_primary], bits_blk(ap, b)
+            )
+        unc = jnp.where((covered == 0) & (prim > 0), 1, 0)
+        return pad_cols(cnt), unc
+
+    def rowmask_blk(ap, b, colsel):
+        """Available rows of the per-lane chosen column, within block b."""
+        rowm = _matvec(inc_blk(b)[:, :n_primary], colsel[:n_primary])
+        return jnp.where((rowm > 0) & (bits_blk(ap, b) > 0), 1, 0)
+
+    def rowmin(ap, colsel):
+        """Lowest available row of the chosen column (global index, [1, T])."""
+        rmin = jnp.full((1, t), _BIG, jnp.int32)
+        for b in range(n_blocks):
+            key = jnp.where(
+                rowmask_blk(ap, b, colsel) > 0, b_iota + b * br, _BIG
+            )
+            rmin = jnp.minimum(rmin, jnp.min(key, axis=0, keepdims=True))
+        return rmin
+
+    def colset_of(ap, colsel, rmin_rep):
+        """Full column set of the selected row ([C_full, T], entries 0/1)."""
+        colset = jnp.zeros((inc_f.shape[1], t), jnp.int32)
+        for b in range(n_blocks):
+            rowsel = jnp.where(
+                (b_iota + b * br == rmin_rep)
+                & (rowmask_blk(ap, b, colsel) > 0),
+                1, 0,
+            )
+            colset = colset + _contract_rows(inc_blk(b), rowsel)
+        return colset
+
+    def apply_take(ap, colsel, rmin_rep, colset, act_take, act_rest=None):
+        """Blockwise conflict elimination (and optional row-exclusion rest).
+
+        Returns guess packed [W_r, T] (conflicts of the selected row
+        dropped, the row itself kept, where ``act_take``) and, when
+        ``act_rest`` is given, rest packed (the selected row excluded)."""
+        act_t = _rep(act_take, br)
+        act_r = None if act_rest is None else _rep(act_rest, br)
+        csat = jnp.minimum(colset, 1)
+        g_words, r_words = [], []
+        for b in range(n_blocks):
+            bits = bits_blk(ap, b)
+            mask = rowmask_blk(ap, b, colsel)
+            rowsel = jnp.where(
+                (b_iota + b * br == rmin_rep) & (mask > 0), 1, 0
+            )
+            conflict = _matvec(inc_blk(b), csat)
+            g_bits = jnp.where(
+                (act_t > 0) & (conflict > 0) & (rowsel == 0), 0, bits
+            )
+            g_words.append(_pack(g_bits, wlo_b, whi_b))
+            if act_r is not None:
+                r_bits = jnp.where((act_r > 0) & (rowsel > 0), 0, bits)
+                r_words.append(_pack(r_bits, wlo_b, whi_b))
+        guess = jnp.concatenate(g_words, axis=0)[:w_rows]
+        rest = (
+            None if act_r is None
+            else jnp.concatenate(r_words, axis=0)[:w_rows]
+        )
+        return guess, rest
+
+    def covered_after(covered, colset, act_take):
+        act_c = _rep(act_take, c_pad)
+        return covered | jnp.where(
+            act_c > 0, jnp.minimum(pad_cols(colset[:n_primary]), 1), 0
+        )
+
+    def body(c):
+        top, stack, meta, sol, k = c
+        has = meta[_HAS : _HAS + 1]  # [1, T] 0/1
+        base = meta[_BASE : _BASE + 1]
+        cnt_s = meta[_COUNT : _COUNT + 1]
+        avail_p, cov_p = split(top)
+        covered = _unpack(cov_p, sel_c)  # [C', T]
+        live_w = _rep(has, w_pad)
+        ap = jnp.where(live_w > 0, pad_words(avail_p), jnp.uint32(0))
+
+        # -- propagate: one forced take per lane per sweep, to a fixpoint --
+        def p_cond(st):
+            _, _, changed, sw = st
+            return changed & (sw < max_sweeps)
+
+        def p_body(st):
+            ap, covered, _, sw = st
+            cnt, unc = counts(ap, covered)
+            forced = jnp.where((unc > 0) & (cnt == 1), 1, 0)
+            has_forced = jnp.max(forced, axis=0, keepdims=True)  # [1, T]
+            colsel = lowest_col(forced)
+            rmin = rowmin(ap, colsel)
+            rmin_rep = _rep(rmin, br)
+            colset = colset_of(ap, colsel, rmin_rep)
+            guess, _ = apply_take(ap, colsel, rmin_rep, colset, has_forced)
+            covered = covered_after(covered, colset, has_forced)
+            return (
+                pad_words(guess), covered, jnp.any(has_forced > 0), sw + 1
+            )
+
+        def lowest_col(mask_i):
+            key = jnp.where(mask_i > 0, c_iota, _BIG)
+            kmin_rep = _rep(jnp.min(key, axis=0, keepdims=True), c_pad)
+            return jnp.where((c_iota == kmin_rep) & (mask_i > 0), 1, 0)
+
+        ap, covered, _, n_sweeps = jax.lax.while_loop(
+            p_cond, p_body, (ap, covered, jnp.bool_(True), jnp.int32(0))
+        )
+
+        # -- status ---------------------------------------------------------
+        cnt, unc = counts(ap, covered)
+        contra_1t = jnp.max(
+            jnp.where((unc > 0) & (cnt == 0), 1, 0), axis=0, keepdims=True
+        )
+        any_unc = jnp.max(unc, axis=0, keepdims=True)
+        slv = jnp.where((any_unc == 0) & (contra_1t == 0) & (has > 0), 1, 0)
+        con = jnp.where((contra_1t > 0) & (has > 0), 1, 0)
+
+        # -- solution capture ----------------------------------------------
+        state_p = jnp.concatenate(
+            [ap[:w_rows], _pack(covered, wlo_c, whi_c)], axis=0
+        )
+        solved_f = meta[_SOLVED : _SOLVED + 1]
+        newly = jnp.where((slv > 0) & (solved_f == 0), 1, 0)
+        d = state_p.shape[0]
+        newly_d = _rep(newly, d)
+        sol = jnp.where(newly_d > 0, state_p, sol)
+        solved_f = jnp.maximum(solved_f, slv)
+        sols_row = meta[_SOLS : _SOLS + 1] + (slv if count_mode else 0)
+
+        # -- branch: MRV column, lowest-row guess vs row-exclusion rest ----
+        undecided = jnp.where((has > 0) & (slv == 0) & (con == 0), 1, 0)
+        branchable = jnp.where((unc > 0) & (cnt >= 1), 1, 0)
+        bkey = jnp.where(
+            branchable > 0, cnt * n_primary + c_iota, _BIG
+        )
+        bmin = jnp.min(bkey, axis=0, keepdims=True)
+        bmin_rep = _rep(bmin, c_pad)
+        colsel = jnp.where((bkey == bmin_rep) & (branchable > 0), 1, 0)
+        rmin = rowmin(ap, colsel)
+        rmin_rep = _rep(rmin, br)
+        colset = colset_of(ap, colsel, rmin_rep)
+        g_ap, rest_ap = apply_take(
+            ap, colsel, rmin_rep, colset, undecided, act_rest=undecided
+        )
+        g_covered = covered_after(covered, colset, undecided)
+        cov_words = _pack(covered, wlo_c, whi_c)
+        rest_p = jnp.concatenate([rest_ap, cov_words], axis=0)
+        guess_p = jnp.concatenate(
+            [g_ap, _pack(g_covered, wlo_c, whi_c)], axis=0
+        )
+
+        # -- push rest ------------------------------------------------------
+        can_push = jnp.where((undecided > 0) & (cnt_s < s), 1, 0)
+        push_slot = (base + cnt_s) % s
+        push_slot_d = _rep(push_slot, d)
+        can_push_d = _rep(can_push, d)
+        stack = jnp.concatenate(
+            [
+                jnp.where(
+                    ((push_slot_d == i) & (can_push_d > 0))[None],
+                    rest_p[None],
+                    stack[i : i + 1],
+                )
+                for i in range(s)
+            ],
+            axis=0,
+        )
+        over_row = jnp.maximum(
+            meta[_OVER : _OVER + 1],
+            jnp.where((undecided > 0) & (can_push == 0), 1, 0),
+        )
+        nodes_row = meta[_NODES : _NODES + 1] + undecided
+
+        # -- pop ------------------------------------------------------------
+        resolved = jnp.maximum(con, slv) if count_mode else con
+        can_pop = jnp.where((resolved > 0) & (cnt_s > 0), 1, 0)
+        pop_slot = (base + cnt_s - 1) % s
+        pop_slot_d = _rep(pop_slot, d)
+        can_pop_d = _rep(can_pop, d)
+        popped = jnp.zeros_like(top)
+        for i in range(s):
+            popped = popped | jnp.where(
+                (pop_slot_d == i) & (can_pop_d > 0), stack[i], jnp.uint32(0)
+            )
+
+        und_d = _rep(undecided, d)
+        new_top = jnp.where(und_d > 0, guess_p, state_p)
+        new_top = jnp.where(can_pop_d > 0, popped, new_top)
+        if count_mode:
+            new_has = jnp.where(
+                (has > 0) & ((resolved == 0) | (can_pop > 0)), 1, 0
+            )
+        else:
+            new_has = jnp.where(
+                (has > 0) & (slv == 0) & ((resolved == 0) | (can_pop > 0)),
+                1, 0,
+            )
+        new_cnt = cnt_s + can_push - can_pop
+
+        meta = jnp.concatenate(
+            [
+                new_has,
+                base,
+                new_cnt,
+                solved_f,
+                over_row,
+                nodes_row,
+                sols_row,
+                meta[_SWEEPS : _SWEEPS + 1] + n_sweeps,
+                meta[_STEPS : _STEPS + 1] + 1,
+                jnp.zeros((16 - 9, t), jnp.int32),
+            ],
+            axis=0,
+        )
+        return new_top, stack, meta, sol, k + 1
+
+    meta = jnp.concatenate(
+        [meta_in, jnp.zeros((16 - meta_in.shape[0], t), jnp.int32)], axis=0
+    )
+    sol0 = jnp.zeros_like(top)
+
+    def cond(c):
+        _, _, meta, _, k = c
+        return jnp.any(meta[_HAS] > 0) & (k < k_steps)
+
+    top, stack, meta, sol, _ = jax.lax.while_loop(
+        cond, body, (top, stack, meta, sol0, jnp.int32(0))
+    )
+    out_top[...] = top
+    out_stack[...] = stack
+    out_sol[...] = sol
+    out_meta[...] = meta
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "problem", "max_sweeps", "k_steps", "tile", "count_mode", "interpret"
+    ),
+)
+def cover_fused_rounds(
+    top_t: jax.Array,  # uint32[1, D, L]
+    stack_t: jax.Array,  # uint32[S, 1, D, L]
+    has_top: jax.Array,  # bool[L]
+    base: jax.Array,  # int32[L]
+    count: jax.Array,  # int32[L]
+    problem: ExactCoverCSP,
+    max_sweeps: int = 64,
+    k_steps: int = 8,
+    tile: int = 128,
+    count_mode: bool = False,
+    interpret: bool | None = None,
+):
+    """Advance every lane up to ``k_steps`` cover rounds in VMEM tiles.
+
+    Same 12-tuple contract as ``pallas_step.fused_rounds`` so the shared
+    XLA driver (``_fused_round``: harvest/purge/steal between dispatches)
+    serves both kernels unchanged."""
+    n_lanes = top_t.shape[-1]
+    d = top_t.shape[1]
+    s = stack_t.shape[0]
+    interp = _interpret_default() if interpret is None else interpret
+    tile = min(tile, n_lanes)
+    if n_lanes % tile:
+        raise ValueError(f"lanes {n_lanes} not a multiple of tile {tile}")
+    n_tiles = n_lanes // tile
+
+    consts = cover_consts(problem)
+    meta = jnp.concatenate(
+        [
+            has_top.astype(jnp.int32)[None],
+            base.astype(jnp.int32)[None],
+            count.astype(jnp.int32)[None],
+            jnp.zeros((5, n_lanes), jnp.int32),
+        ],
+        axis=0,
+    )
+    kernel = functools.partial(
+        _cover_kernel,
+        n_primary=problem.n_primary,
+        w_rows=problem.w_rows,
+        max_sweeps=max_sweeps,
+        k_steps=k_steps,
+        count_mode=count_mode,
+    )
+    vmem = dict(memory_space=_VMEM) if (_VMEM is not None and not interp) else {}
+    lane_spec = lambda *lead: pl.BlockSpec(  # noqa: E731
+        (*lead, tile), lambda i: (*(0,) * len(lead), i), **vmem
+    )
+    const_spec = lambda a: pl.BlockSpec(  # noqa: E731
+        a.shape, lambda i: (0,) * a.ndim, **vmem
+    )
+    # The default scoped-vmem limit (16 MB) is what multi-block instances
+    # hit first — pentomino 6x10 missed it by 396 KB with everything else
+    # in place.  v5e carries far more physical VMEM than the conservative
+    # default; raise the ceiling and let the measured probes set the real
+    # admission boundary (benchmarks/probe_cover_kernel.py).
+    from jax.experimental.pallas import tpu as pltpu
+
+    params = (
+        {}
+        if interp
+        else {
+            "compiler_params": pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024
+            )
+        }
+    )
+    out_top, out_stack, out_sol, out_meta = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        **params,
+        in_specs=[
+            *(const_spec(np.asarray(c)) for c in consts),
+            lane_spec(d),
+            lane_spec(s, d),
+            lane_spec(8),
+        ],
+        out_specs=(
+            lane_spec(d),
+            lane_spec(s, d),
+            lane_spec(d),
+            lane_spec(16),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((d, n_lanes), jnp.uint32),
+            jax.ShapeDtypeStruct((s, d, n_lanes), jnp.uint32),
+            jax.ShapeDtypeStruct((d, n_lanes), jnp.uint32),
+            jax.ShapeDtypeStruct((16, n_lanes), jnp.int32),
+        ),
+        interpret=interp,
+    )(
+        *(jnp.asarray(c) for c in consts),
+        top_t[0],
+        stack_t[:, 0],
+        meta,
+    )
+
+    tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+    sweeps_total = jnp.sum(out_meta[_SWEEPS][tile_starts])
+    steps_max = jnp.max(out_meta[_STEPS][tile_starts])
+    return (
+        out_top[None],
+        out_stack[:, None],
+        out_meta[_HAS] > 0,
+        out_meta[_BASE],
+        out_meta[_COUNT],
+        out_meta[_SOLVED] > 0,
+        out_sol[None],
+        out_meta[_OVER] > 0,
+        out_meta[_NODES],
+        out_meta[_SOLS],
+        sweeps_total,
+        steps_max,
+    )
+
+
+def _rounds_fn(problem: ExactCoverCSP, config, lanes: int):
+    def rounds(f):
+        return cover_fused_rounds(
+            f.top_t, f.stack_t, f.has_top, f.base, f.count,
+            problem,
+            max_sweeps=config.max_sweeps,
+            k_steps=config.fused_steps,
+            tile=min(128, lanes),
+            count_mode=config.count_all,
+        )
+
+    return rounds
+
+
+@functools.partial(jax.jit, static_argnames=("problem", "config"))
+def advance_cover_fused(state, step_limit: jax.Array, problem, config):
+    """Cover twin of ``pallas_step.advance_frontier_fused``: advance a
+    lane-first generic frontier by fused dispatches until every job
+    resolves or ``steps`` reaches ``step_limit`` (dynamic — the stepped
+    drivers pass successive limits against one compiled program, keeping
+    each device dispatch wall-bounded for the watchdog discipline)."""
+    from distributed_sudoku_solver_tpu.ops.pallas_step import (
+        _run_fused,
+        frontier_to_fused,
+        fused_to_frontier,
+    )
+
+    limit = jnp.minimum(jnp.int32(step_limit), jnp.int32(config.max_steps))
+    lanes = state.has_top.shape[0]
+    fs = frontier_to_fused(state)
+    fs = _run_fused(
+        fs, None, config, limit, rounds_fn=_rounds_fn(problem, config, lanes)
+    )
+    return fused_to_frontier(fs)
+
+
+def cover_fused_lanes(n_lanes: int) -> int:
+    """Round a cover lane count to a fused-kernel-valid width (128-multiples
+    beyond one whole-array tile, the Mosaic lane-tiling rule)."""
+    if n_lanes <= 128:
+        return n_lanes
+    return -(-n_lanes // 128) * 128
+
+
+@functools.partial(jax.jit, static_argnames=("problem", "config"))
+def solve_cover_fused(states0: jax.Array, problem: ExactCoverCSP, config):
+    """Fused-step cover solve: ``solve_csp``'s contract under fused rounds.
+
+    Root states [J, 1, D] (packed avail/covered, ``models/cover.py``); the
+    solution field of the result is the raw solved state, decodable with
+    the family's ``chosen_rows``/``decode_*`` helpers, exactly like the
+    composite path."""
+    import dataclasses
+
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier
+    from distributed_sudoku_solver_tpu.ops.solve import finalize_frontier
+    from distributed_sudoku_solver_tpu.ops.pallas_step import (
+        _run_fused,
+        frontier_to_fused,
+        fused_to_frontier,
+    )
+
+    n_jobs = states0.shape[0]
+    lanes = cover_fused_lanes(config.resolve_lanes(n_jobs))
+    config = dataclasses.replace(config, lanes=lanes)
+
+    state = init_frontier(states0, config)
+    fs = frontier_to_fused(state)
+    fs = _run_fused(
+        fs, None, config, jnp.int32(config.max_steps),
+        rounds_fn=_rounds_fn(problem, config, lanes),
+    )
+    return finalize_frontier(fused_to_frontier(fs))

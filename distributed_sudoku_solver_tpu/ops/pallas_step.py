@@ -37,12 +37,11 @@ and a per-lane solution counter scatter-adds into job counts per dispatch
 — measured 3.31x over the composite step with bit-identical exact counts
 (BENCHMARKS.md).  Scope: the kernel hardcodes the SUDOKU propagation /
 status / branch algebra (the fixpoint, the unit reductions, the digit
-branch), so the generalized exact-cover family (``models/cover.py``:
-n-queens, pentomino) keeps the composite step — serving cover instances
-from VMEM would be a second kernel over the packed row-conflict algebra,
-not a flag on this one.  That is an architectural boundary, not a
-measured refutation: the cover family's 1.8-2.7x-over-native wins
-(BENCHMARKS.md) stand to gain similarly if that kernel is ever built.
+branch).  The generalized exact-cover family has its own whole-round
+VMEM kernel since round 5 (``ops/pallas_cover.py`` — the packed
+row-conflict algebra as MXU matmuls, sharing this module's XLA driver
+via the ``rounds_fn`` seam in :func:`_fused_round`), measured 1.5-2.3x
+over the composite step on single-block instances (BENCHMARKS.md).
 
 Reference bar: this is the hot loop of ``/root/reference/DHT_Node.py:
 474-538`` (recursive guess/validate/backtrack) as one resident TPU kernel.
@@ -681,25 +680,35 @@ def _steal_t(top_t, has_top, stack_t, base, count, job, job_live):
     return top_t, has_top, base, count, job, n_pairs
 
 
-def _fused_round(fs: FusedFrontier, geom: Geometry, config) -> FusedFrontier:
-    """One kernel dispatch (k_steps rounds) + the XLA-side job bookkeeping."""
+def _fused_round(
+    fs: FusedFrontier, geom: Geometry, config, rounds_fn=None
+) -> FusedFrontier:
+    """One kernel dispatch (k_steps rounds) + the XLA-side job bookkeeping.
+
+    ``rounds_fn`` (FusedFrontier -> the 12-tuple :func:`fused_rounds`
+    returns) swaps in a different whole-round kernel — the exact-cover
+    kernel (``ops/pallas_cover.py``) shares every piece of this job
+    bookkeeping (harvest, purge, steal) by providing its own; ``None``
+    dispatches the Sudoku kernel."""
     n_jobs = fs.solved.shape[0]
     n_lanes = fs.has_top.shape[0]
     job_safe = jnp.clip(fs.job, 0, n_jobs - 1)
 
+    if rounds_fn is None:
+        rounds_fn = lambda f: fused_rounds(  # noqa: E731
+            f.top_t, f.stack_t, f.has_top, f.base, f.count,
+            geom,
+            rules=config.rules,
+            branch_rule=config.branch,
+            max_sweeps=config.max_sweeps,
+            k_steps=config.fused_steps,
+            # Lanes were validated/rounded by solve_batch_fused: <= 128
+            # lanes use one full-array tile, beyond that 128-lane tiles.
+            tile=min(128, n_lanes),
+            count_mode=config.count_all,
+        )
     (top_t, stack_t, has_top, base, count, lane_solved, lane_sol_t,
-     lane_over, nodes_d, sols_d, sweeps_t, steps_m) = fused_rounds(
-        fs.top_t, fs.stack_t, fs.has_top, fs.base, fs.count,
-        geom,
-        rules=config.rules,
-        branch_rule=config.branch,
-        max_sweeps=config.max_sweeps,
-        k_steps=config.fused_steps,
-        # Lanes were validated/rounded by solve_batch_fused: <= 128 lanes
-        # use one full-array tile, beyond that always 128-lane tiles.
-        tile=min(128, n_lanes),
-        count_mode=config.count_all,
-    )
+     lane_over, nodes_d, sols_d, sweeps_t, steps_m) = rounds_fn(fs)
 
     live_jobs = fs.job >= 0
     lane_ids = jnp.arange(n_lanes, dtype=jnp.int32)
@@ -780,7 +789,7 @@ def _fused_live(fs: FusedFrontier) -> jax.Array:
 
 
 def _run_fused(
-    fs: FusedFrontier, geom: Geometry, config, limit: jax.Array
+    fs: FusedFrontier, geom: Geometry, config, limit: jax.Array, rounds_fn=None
 ) -> FusedFrontier:
     """Dispatch fused rounds until nothing is live or ``steps`` hits ``limit``.
 
@@ -789,13 +798,14 @@ def _run_fused(
     ``fused_steps`` quanta — the max in-kernel rounds across tiles per
     dispatch — so the loop may overshoot ``limit`` by up to
     ``fused_steps - 1`` rounds (see :func:`solve_batch_fused` on the step
-    accounting approximation)."""
+    accounting approximation).  ``rounds_fn`` swaps the whole-round kernel
+    (see :func:`_fused_round`); ``geom`` is unused when it is given."""
 
     def cond(f: FusedFrontier):
         return jnp.any(_fused_live(f)) & (f.steps < limit)
 
     return jax.lax.while_loop(
-        cond, lambda f: _fused_round(f, geom, config), fs
+        cond, lambda f: _fused_round(f, geom, config, rounds_fn), fs
     )
 
 

@@ -103,11 +103,19 @@ def solve_csp(
 ) -> SolveResult:
     """Solve root states [J, h, w] of any CSP; solution is the raw solved state."""
     if config.step_impl == "fused":
-        # The fused kernel hardcodes the Sudoku kernels; a silent composite
+        from distributed_sudoku_solver_tpu.models.cover import ExactCoverCSP
+
+        if isinstance(problem, ExactCoverCSP):
+            from distributed_sudoku_solver_tpu.ops.pallas_cover import (
+                solve_cover_fused,
+            )
+
+            return solve_cover_fused(jnp.asarray(states0), problem, config)
+        # No fused kernel for other CSP families; a silent composite
         # fallback would mislabel A/B measurements (the branch_k precedent).
         raise ValueError(
-            "step_impl='fused' supports the Sudoku entry points only; "
-            f"got a generic {type(problem).__name__}"
+            "step_impl='fused' supports the Sudoku and exact-cover "
+            f"families only; got a generic {type(problem).__name__}"
         )
     state = init_frontier(states0, config)
     state = run_frontier(state, problem, config)

@@ -1,0 +1,193 @@
+"""Fused VMEM cover kernel (``ops/pallas_cover.py``) vs the composite engine.
+
+Mirrors the Sudoku fused-step suite's contract (``tests/test_fused_step.py``):
+the fused path is a gated strategy — verdicts must be sound and counts
+exact, while node accounting may differ at ``fused_steps`` granularity.
+On the CPU test mesh the kernel runs in Pallas interpret mode (plain XLA
+semantics); the hardware lanes live in ``tests/test_tpu.py`` and the
+measured rows in ``benchmarks/bench_cover.py``.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.cover import (
+    build_cover,
+    decode_sudoku_cover,
+    sudoku_clue_rows,
+    sudoku_cover,
+)
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.models.nqueens import (
+    decode_queens,
+    is_valid_queens,
+    nqueens_cover,
+)
+from distributed_sudoku_solver_tpu.models.pentomino import (
+    decode_tiling,
+    is_valid_tiling,
+    pentomino_cover,
+)
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch, solve_csp
+
+FUSED = SolverConfig(
+    min_lanes=64, stack_slots=32, max_steps=40_000, step_impl="fused",
+    fused_steps=4,
+)
+XLA = SolverConfig(min_lanes=64, stack_slots=32, max_steps=40_000)
+
+
+def _roots(problem, n_jobs=1):
+    return np.repeat(problem.initial_state()[None], n_jobs, axis=0)
+
+
+@pytest.mark.parametrize("n", [4, 6, 8])
+def test_fused_nqueens_solved_and_valid(n):
+    p = nqueens_cover(n)
+    res = solve_csp(_roots(p), p, FUSED)
+    assert bool(res.solved[0])
+    queens = decode_queens(p, np.asarray(res.solution[0]), n)
+    assert is_valid_queens(queens, n)
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_fused_nqueens_unsat_proven(n):
+    p = nqueens_cover(n)
+    res = solve_csp(_roots(p), p, FUSED)
+    assert not bool(res.solved[0])
+    assert bool(res.unsat[0])
+    assert not bool(res.overflowed[0])
+
+
+def test_fused_first_solution_matches_composite():
+    """Identical branch heuristics => the first solution found agrees with
+    the composite engine on a single-lane-per-job search."""
+    import dataclasses
+
+    p = nqueens_cover(7)
+    one_lane = dict(lanes=1, min_lanes=1, steal=False)
+    rf = solve_csp(
+        _roots(p), p, dataclasses.replace(FUSED, **one_lane)
+    )
+    rx = solve_csp(_roots(p), p, dataclasses.replace(XLA, **one_lane))
+    assert bool(rf.solved[0]) and bool(rx.solved[0])
+    assert (
+        p.chosen_rows(np.asarray(rf.solution[0])).tolist()
+        == p.chosen_rows(np.asarray(rx.solution[0])).tolist()
+    )
+
+
+def test_fused_count_all_exact_nqueens():
+    import dataclasses
+
+    p = nqueens_cover(6)
+    cfg = dataclasses.replace(FUSED, count_all=True)
+    res = solve_csp(_roots(p), p, cfg)
+    assert int(res.sol_count[0]) == 4  # OEIS A000170(6)
+    assert bool(res.unsat[0])  # ran to exhaustion
+    assert not bool(res.overflowed[0])
+
+
+def test_fused_count_all_multi_block_pentomino():
+    """A multi-block instance (w_rows > 32 words streams the row space in
+    blocks) counts exactly: pentomino 3x20 has 8 tilings (2 classic x 4
+    rectangle symmetries)."""
+    import dataclasses
+
+    p = pentomino_cover(3, 20)
+    assert p.w_rows > 32  # the point of the test: multi-block streaming
+    cfg = dataclasses.replace(
+        FUSED, min_lanes=128, stack_slots=64, max_steps=200_000,
+        count_all=True,
+    )
+    res = solve_csp(_roots(p), p, cfg)
+    rx = solve_csp(
+        _roots(p), p,
+        dataclasses.replace(
+            XLA, min_lanes=128, stack_slots=64, max_steps=200_000,
+            count_all=True,
+        ),
+    )
+    assert int(res.sol_count[0]) == int(rx.sol_count[0]) == 8
+    assert bool(res.unsat[0]) and not bool(res.overflowed[0])
+
+
+def test_fused_pentomino_tiling_valid():
+    import dataclasses
+
+    p = pentomino_cover(5, 12)
+    cfg = dataclasses.replace(
+        FUSED, min_lanes=128, stack_slots=64, max_steps=200_000
+    )
+    res = solve_csp(_roots(p), p, cfg)
+    assert bool(res.solved[0])
+    assert is_valid_tiling(decode_tiling(p, np.asarray(res.solution[0]), 5, 12))
+
+
+def test_fused_overflow_downgrades_not_wrong():
+    """A stack too shallow for the search must flag overflow (count is a
+    lower bound), never report a wrong verdict."""
+    import dataclasses
+
+    p = nqueens_cover(8)
+    cfg = dataclasses.replace(
+        FUSED, lanes=1, min_lanes=1, stack_slots=2, steal=False,
+        count_all=True,
+    )
+    res = solve_csp(_roots(p), p, cfg)
+    assert bool(res.overflowed[0])
+    # A 2-slot stack on one lane drops most of the 8-queens tree: the
+    # count must come back as a strict lower bound, never inflated.
+    assert 0 <= int(res.sol_count[0]) < 92
+
+
+def test_fused_sudoku_cover_matches_native_kernel():
+    """Sudoku-as-cover through the fused cover kernel agrees with the
+    native Sudoku kernels — two independent engines, one answer."""
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+    p = sudoku_cover(SUDOKU_9)
+    root = p.state_with_rows_taken(sudoku_clue_rows(EASY_9))[None]
+    res = solve_csp(root, p, FUSED)
+    assert bool(res.solved[0])
+    via_cover = decode_sudoku_cover(p, np.asarray(res.solution[0]), 9)
+    native = solve_batch(np.asarray(EASY_9, np.int32)[None], SUDOKU_9, XLA)
+    assert np.array_equal(via_cover, np.asarray(native.solution[0]))
+
+
+def test_fused_rejects_non_cover_csp():
+    from distributed_sudoku_solver_tpu.ops.solve import sudoku_csp
+
+    csp = sudoku_csp(SUDOKU_9, XLA)
+    with pytest.raises(ValueError, match="exact-cover"):
+        solve_csp(
+            np.zeros((1, 9, 9), np.uint32), csp,
+            SolverConfig(min_lanes=16, step_impl="fused"),
+        )
+
+
+def test_incidence_distinguishes_digest():
+    """Instances differing only in secondary columns must trace distinctly
+    (the fused kernel bakes the full incidence into the program)."""
+    a = np.zeros((4, 3), bool)
+    a[:, 0] = True
+    a[0, 2] = a[1, 2] = True  # secondary column shared by rows 0, 1
+    b = a.copy()
+    b[2, 2] = True
+    pa = build_cover("d", a, 1)
+    pb = build_cover("d", b, 1)
+    assert pa != pb
+
+
+def test_legacy_instances_without_incidence_raise_cleanly():
+    from distributed_sudoku_solver_tpu.models.cover import ExactCoverCSP
+    from distributed_sudoku_solver_tpu.ops.pallas_cover import cover_consts
+
+    p = nqueens_cover(4)
+    legacy = ExactCoverCSP(
+        name=p.name, n_rows=p.n_rows, n_primary=p.n_primary,
+        col_rows=p.col_rows, row_cols=p.row_cols, elim=p.elim,
+    )
+    with pytest.raises(ValueError, match="incidence"):
+        cover_consts(legacy)

@@ -303,3 +303,35 @@ def test_bulk_auto_picks_fused_at_16x16_on_device():
     assert res.solved.all()
     for i in range(0, 64, 16):
         assert is_valid_solution(res.solution[i], g16)
+
+
+def test_fused_cover_kernel_on_device():
+    """The exact-cover VMEM kernel (ops/pallas_cover.py) compiles through
+    Mosaic on hardware and enumerates exactly: 8-queens = 92 (single-block
+    row space) and pentomino 3x20 = 8 (multi-block streaming).  The
+    precision trap this pins: f32 dots at default precision round the
+    unpack matmuls' 16-bit words — these counts catch any regression."""
+    import dataclasses
+
+    from distributed_sudoku_solver_tpu.models.nqueens import nqueens_cover
+    from distributed_sudoku_solver_tpu.models.pentomino import pentomino_cover
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_csp
+
+    cfg = SolverConfig(
+        min_lanes=128, stack_slots=32, max_steps=200_000,
+        count_all=True, step_impl="fused",
+    )
+    q8 = nqueens_cover(8)
+    res = solve_csp(q8.initial_state()[None], q8, cfg)
+    assert int(res.sol_count[0]) == 92
+    assert bool(res.unsat[0]) and not bool(res.overflowed[0])
+
+    p = pentomino_cover(3, 20)
+    assert p.w_rows > 32  # multi-block: exercises the blocked row passes
+    res = solve_csp(
+        p.initial_state()[None], p,
+        dataclasses.replace(cfg, stack_slots=64),
+    )
+    assert int(res.sol_count[0]) == 8
+    assert bool(res.unsat[0]) and not bool(res.overflowed[0])
