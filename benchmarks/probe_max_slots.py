@@ -1,0 +1,115 @@
+"""Measure the fused Sudoku kernel's stack-depth compile boundaries.
+
+VERDICT r4 #4a: `ops/pallas_step._max_slots` carried five geometry caps
+that were guesses (n = 10, 11 inherited 12x12's S = 16; 13-15 and 25
+were rejected without probes).  This probe measures every geometry's
+actual boundary on hardware — gridded (two 128-lane tiles, the
+double-buffered multi-tile regime) and whole-array (one 128-lane tile)
+— by compiling and running ONE fused round at each depth of a ladder
+until the first failure.
+
+Round-5 context: the boundaries move, because the round-4 calibration
+was unknowingly against Mosaic's default 16 MB scoped-vmem ceiling, not
+against hardware (``pallas_propagate._vmem_params`` now raises it).
+Whatever this probe measures becomes the new `_max_slots` table.
+
+    python benchmarks/probe_max_slots.py             # full sweep
+    python benchmarks/probe_max_slots.py --geoms 25  # one geometry
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LADDER = [8, 12, 16, 20, 24, 32, 48, 64, 96, 128]
+
+
+# Box shapes per size: squares where possible, the tested rectangular
+# split otherwise; primes (11, 13) get degenerate 1 x n boxes (the box
+# unit collapses onto the row unit — still a valid, total CSP, and the
+# only way those sizes exist at all).
+BOXES = {
+    9: (3, 3), 10: (2, 5), 11: (1, 11), 12: (3, 4), 13: (1, 13),
+    14: (2, 7), 15: (3, 5), 16: (4, 4), 25: (5, 5),
+}
+
+
+def probe(n: int, s: int, lanes: int, tile: int) -> tuple[bool, float, str]:
+    """Compile + run one fused round; (ok, seconds, error-head)."""
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import Geometry
+    from distributed_sudoku_solver_tpu.ops.pallas_step import fused_rounds
+
+    geom = Geometry(*BOXES[n])
+    top = jnp.full((n, n, lanes), jnp.uint32(geom.full_mask))
+    stack = jnp.zeros((s, n, n, lanes), jnp.uint32)
+    has = jnp.ones(lanes, bool)
+    zero = jnp.zeros(lanes, jnp.int32)
+    t0 = time.time()
+    try:
+        out = fused_rounds(
+            top, stack, has, zero, zero, geom,
+            k_steps=1, tile=tile, max_sweeps=8,
+        )
+        np.asarray(out[2])  # force execution, not just trace
+        return True, time.time() - t0, ""
+    except Exception as e:  # noqa: BLE001 — the probe's output IS the error
+        msg = str(e)
+        key = next(
+            (l for l in msg.splitlines() if "Scoped allocation" in l or "RESOURCE" in l),
+            msg.splitlines()[0] if msg else "",
+        )
+        return False, time.time() - t0, key[:220]
+
+
+def sweep(n: int) -> None:
+    for mode, lanes, tile in (("whole", 128, 128), ("gridded", 256, 128)):
+        best = 0
+        for s in LADDER:
+            ok, dt, err = probe(n, s, lanes, tile)
+            print(json.dumps({
+                "metric": "max_slots_probe",
+                "n": n,
+                "mode": mode,
+                "stack_slots": s,
+                "ok": ok,
+                "compile_s": round(dt, 1),
+                "error": err if not ok else None,
+            }), flush=True)
+            if not ok:
+                break
+            best = s
+        print(json.dumps({
+            "metric": "max_slots_boundary", "n": n, "mode": mode, "max": best,
+        }), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--geoms", type=str, default="9,10,11,12,13,14,15,16,25")
+    args = ap.parse_args()
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".cache", "xla")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    print(json.dumps({
+        "metric": "session", "device": str(jax.devices()[0].platform),
+    }), flush=True)
+    for g in args.geoms.split(","):
+        sweep(int(g))
+
+
+if __name__ == "__main__":
+    main()

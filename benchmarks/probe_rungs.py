@@ -1,0 +1,137 @@
+"""Escalation-rung probes (VERDICT r4 #2): the rungs earn their defaults.
+
+Rungs were named as half of the end-to-end gap, yet ran an unmeasured
+propagator choice and a composite-only step engine.  Two experiments,
+one JSON line each (BENCHMARKS.md records the adopted numbers):
+
+  prop   — in-rung propagator A/B ('slices' vs 'pallas').  With the
+           fused first pass (auto on TPU) the first-pass fixpoint runs
+           IN-KERNEL, so `BulkConfig.propagator` only reaches the rungs:
+           the A/B isolates exactly the contested choice.
+  fused  — rung step-engine A/B on the default 9x9 ladder and the
+           VERDICT-suggested (64, 128, 48) gang rung, composite vs
+           `rung_step_impl='fused'` (admissible since the round-5
+           stack-depth re-measurement: 9x9 compiles to S=128).
+
+Both run the headline distinct corpus plus a harder 22-clue straggler
+corpus (more rung survivors), reporting the trace-attributed rung wall
+alongside the total so first-pass noise doesn't wash the comparison.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def emit(**kw) -> None:
+    print(json.dumps(kw), flush=True)
+
+
+def corpus(b: int, n_clues: int):
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, puzzle_batch
+
+    distinct = puzzle_batch(
+        SUDOKU_9, b - len(HARD_9), seed=7 if n_clues == 24 else 91,
+        n_clues=n_clues,
+    )
+    return np.concatenate([np.stack(HARD_9), distinct]).astype(np.int32)
+
+
+def run(grids, cfg, label: str) -> dict:
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.bulk import solve_bulk
+
+    solve_bulk(grids, SUDOKU_9, cfg)  # warm
+    best = None
+    for _ in range(3):
+        tr: dict = {}
+        t0 = time.perf_counter()
+        res = solve_bulk(grids, SUDOKU_9, cfg, trace=tr)
+        wall = time.perf_counter() - t0
+        if best is None or wall < best["wall_s"]:
+            best = {
+                "config": label,
+                "wall_s": round(wall, 3),
+                "boards_per_s": round(len(grids) / wall, 1),
+                "solved": int(res.solved.sum()),
+                "first_pass_s": round(tr["first_pass_s"], 3),
+                "rung_wall_s": round(
+                    sum(r["wall_s"] for r in tr["rungs"]), 3
+                ),
+                "rung_dispatches": sum(r["dispatches"] for r in tr["rungs"]),
+                "remaining_after_first": tr["remaining_after_first"],
+                "rungs": [
+                    (r["survivors_in"], r["survivors_out"], r["lanes"], r["slots"])
+                    for r in tr["rungs"]
+                ],
+            }
+    return best
+
+
+def bench_prop(grids, tag: str) -> None:
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig
+
+    for prop in ("slices", "pallas"):
+        emit(
+            metric="rung_propagator_ab", corpus=tag,
+            **run(grids, BulkConfig(propagator=prop), prop),
+        )
+
+
+def bench_fused(grids, tag: str) -> None:
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig
+
+    ladders = {
+        "default": None,
+        "gang12848": ((64, 128, 48),),
+    }
+    for lname, rungs in ladders.items():
+        for impl in (None, "fused"):
+            label = f"{lname}:{impl or 'xla'}"
+            emit(
+                metric="rung_step_ab", corpus=tag,
+                **run(
+                    grids,
+                    BulkConfig(rungs=rungs, rung_step_impl=impl),
+                    label,
+                ),
+            )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("experiments", nargs="*", default=["prop", "fused"])
+    ap.add_argument("--b", type=int, default=65536)
+    ap.add_argument("--hard-b", type=int, default=4096)
+    args = ap.parse_args()
+    os.environ.setdefault(
+        "DSST_PUZZLE_CACHE", os.path.join(REPO, ".cache", "puzzles")
+    )
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".cache", "xla")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    emit(metric="session", device=str(jax.devices()[0].platform))
+
+    headline = corpus(args.b, 24)
+    hard = corpus(args.hard_b, 22)
+    for exp in args.experiments:
+        fn = {"prop": bench_prop, "fused": bench_fused}[exp]
+        fn(headline, "headline24")
+        fn(hard, "hard22")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import jax
@@ -92,6 +93,16 @@ class BulkConfig:
     # 32 fastest (417k vs 359k boards/s) but e2e through the tunnel was a
     # wash; benchmarks/anatomy.py re-probes it per surface (VERDICT r4 #1).
     fused_steps: Optional[int] = None
+    # Step engine for the escalation rungs.  None = auto: 'fused' on TPU
+    # for any rung shape the kernel admits, 'xla' elsewhere.  The round-4
+    # rationale for composite-only rungs ("gang rungs live off steal
+    # reaction latency") was measured wrong where it matters: the fused
+    # gang rung took the deep-25x25 row 5.6 -> 20-24 boards/s (3.6-4.3x,
+    # benchmarks/probe_25.py), and at 9x9/16x16 rungs never fire on any
+    # measured corpus (benchmarks/probe_rungs.py: remaining_after_first
+    # == 0 even at 22-clue hardness), so auto-fused risks nothing there.
+    # A rung whose shape the kernel cannot serve falls back to composite.
+    rung_step_impl: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.propagator not in (None, "xla", "pallas", "slices"):
@@ -102,6 +113,10 @@ class BulkConfig:
             raise ValueError(f"unknown rules {self.rules!r}")
         if self.step_impl not in (None, "xla", "fused"):
             raise ValueError(f"unknown step_impl {self.step_impl!r}")
+        if self.rung_step_impl not in (None, "xla", "fused"):
+            raise ValueError(
+                f"unknown rung_step_impl {self.rung_step_impl!r}"
+            )
 
 
 def default_rungs(geom: Geometry) -> tuple:
@@ -114,18 +129,21 @@ def default_rungs(geom: Geometry) -> tuple:
     stops grinding at 4-lane parallelism and escalates.
 
     Giant boards (16x16 up): stragglers are *deep*, so they go straight to
-    128-lane OR-parallel gangs with a 32-slot stack (the widest shape that
-    fits ``rung_stack_mb`` at 25x25 without narrowing).  Measured on the
-    45%-clue 25x25 corpus: 1.90 -> 5.55 boards/s (BENCHMARKS.md,
-    "Inference tiers and rung shapes on deep search").  A deep-stack
-    completeness rung follows: a lane whose DFS overflows 32 deferred
-    siblings drops a subtree and downgrades its verdict to unknown
-    (``ops/frontier.py``), so such boards retry at 256 slots — narrower
-    (16 lanes, the ``rung_stack_mb`` ceiling at 25x25) but overflow-proof
-    in practice, preserving the old ladder's completeness guarantee.
+    128-lane OR-parallel gangs — at a 24-slot stack since round 5, the
+    deepest gridded depth the fused kernel admits at 25x25
+    (``pallas_step._max_slots``), so the gang rung can run the fused step
+    engine: measured on the 45%-clue 25x25 corpus, the fused gang took
+    the round-2-worst row 5.6 -> 20-24 boards/s (S=24 vs the old S=32
+    composite gang was a wash composite-vs-composite: 5.54 vs 5.64 —
+    BENCHMARKS.md "Pipeline anatomy / giant boards", round 5).  A
+    deep-stack completeness rung follows: a lane whose DFS overflows its
+    deferred-sibling slots drops a subtree and downgrades its verdict to
+    unknown (``ops/frontier.py``), so such boards retry at 256 slots —
+    narrower (16 lanes, the ``rung_stack_mb`` ceiling at 25x25) but
+    overflow-proof in practice, preserving completeness.
     """
     if geom.n >= 16:
-        return ((64, 128, 32), (64, 16, 256))
+        return ((64, 128, 24), (64, 16, 256))
     return ((2048, 4, 64, 16_384), (64, 64, 256))
 
 
@@ -215,17 +233,23 @@ def solve_bulk(
     pad_board = solved_board(geom)
     prop = config.propagator or _auto_propagator()
 
+    # Wire format both directions (ops/wire.py): single result array — one
+    # upload, one dispatch, one fetch per chunk.  Single-chip chunks use
+    # the smallest format for the geometry ('dense' 10-bit triplets at
+    # 9x9: 35+36 B/board vs nibble's 41+42 — the pipeline is
+    # transfer-bound, so bytes convert ~1:1 into throughput); the mesh
+    # path keeps the legacy format its sharded driver speaks.
+    fmt = wire.best_format(geom) if mesh is None else "packed"
+
     def run_chunk(batch: np.ndarray, scfg: SolverConfig):
-        # Wire format both directions (ops/wire.py): nibble-packed boards,
-        # single result array — one upload, one dispatch, one fetch.
-        packed = jnp.asarray(wire.pack_grids_host(batch, geom))
+        packed = jnp.asarray(wire.pack_grids_for(batch, geom, fmt))
         if mesh is not None:
             from distributed_sudoku_solver_tpu.parallel.sharded import (
                 solve_batch_sharded_wire,
             )
 
             return solve_batch_sharded_wire(packed, geom, scfg, mesh)
-        return solve_batch_wire(packed, geom, scfg)
+        return solve_batch_wire(packed, geom, scfg, fmt=fmt)
 
     def pad_to(batch: np.ndarray, size: int) -> np.ndarray:
         # Pad with an already-complete board: its lane resolves on step one
@@ -246,10 +270,10 @@ def solve_bulk(
     if step_impl is None:
         # Auto-fused wherever the (n, stack_slots) working set fits VMEM at
         # the mandatory 128-lane tile (ops/pallas_step.fused_tile) — that
-        # covers 9x9-class (measured 1.45-2.4x, BENCHMARKS.md) and, since
-        # round 4, 16x16 at S=12 (measured 1.1-2.0x across 512-2048-board
-        # corpora; the r3 "fused loses at 16x16" reading did not reproduce
-        # and is retired).  25x25 never fits and stays composite.  Meshes
+        # covers 9x9-class (measured 1.45-2.4x, BENCHMARKS.md), 16x16
+        # (1.1-2.0x, round 4), and since the round-5 scoped-vmem
+        # re-measurement 25x25 too (fused first pass 1.14 -> 0.47 s on
+        # the deep 45%-clue corpus, benchmarks/probe_25.py).  Meshes
         # qualify too: the sharded driver dispatches to
         # parallel/fused_sharded (per-chip fused rounds + ring collectives).
         from distributed_sudoku_solver_tpu.ops.pallas_step import fused_tile
@@ -287,8 +311,8 @@ def solve_bulk(
             stage["drain_s"] += _time.perf_counter() - t0
         hi = min(lo + chunk, b)
         k = hi - lo
-        r_sol, r_solved, r_unsat, r_branched = wire.unpack_result_host(
-            fetched, geom
+        r_sol, r_solved, r_unsat, r_branched = wire.unpack_result_for(
+            fetched, geom, fmt
         )
         r_sol, r_solved = r_sol[:k], r_solved[:k]
         solution[lo:hi][r_solved] = r_sol[r_solved]
@@ -296,19 +320,26 @@ def solve_bulk(
         unsat[lo:hi] = r_unsat[:k]
         branched[lo:hi] = r_branched[:k]
 
+    # Result fetches run on a single worker thread: ``np.asarray`` releases
+    # the GIL while it waits out device compute + the downlink, so packing
+    # and uploading chunk k+2 overlaps draining chunk k (measured in the
+    # round-5 anatomy: the drain wall IS most of the first-pass wall — the
+    # submit loop used to sit inside it).  One worker keeps drains ordered
+    # (writes into the shared result arrays race-free by construction).
     t_first = _time.perf_counter()
-    pending: list[tuple[int, object]] = []
-    for lo in range(0, b, chunk):
-        batch = pad_to(grids[lo : lo + chunk], chunk)
-        t0 = _time.perf_counter()
-        res = run_chunk(batch, first_cfg)
-        if stage is not None:
-            stage["pack_s"] += _time.perf_counter() - t0
-        pending.append((lo, res))
-        if len(pending) >= max(1, config.inflight):
-            drain(*pending.pop(0))
-    while pending:
-        drain(*pending.pop(0))
+    pending: list = []
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        for lo in range(0, b, chunk):
+            batch = pad_to(grids[lo : lo + chunk], chunk)
+            t0 = _time.perf_counter()
+            res = run_chunk(batch, first_cfg)
+            if stage is not None:
+                stage["pack_s"] += _time.perf_counter() - t0
+            pending.append(pool.submit(drain, lo, res))
+            if len(pending) >= max(1, config.inflight):
+                pending.pop(0).result()
+        for f in pending:
+            f.result()
 
     by_propagation = solved & ~branched
     searched = int(branched.sum())
@@ -339,11 +370,17 @@ def solve_bulk(
             return wire.unpack_result_host(np.asarray(res), geom)
         from distributed_sudoku_solver_tpu.utils.checkpoint import advance_frontier
 
+        if scfg.step_impl == "fused":
+            from distributed_sudoku_solver_tpu.ops.pallas_step import (
+                advance_frontier_fused as _advance,
+            )
+        else:
+            _advance = advance_frontier
         state = _rung_start(jnp.asarray(batch.astype(np.uint8)), geom, scfg)
         limit = 0
         while limit < scfg.max_steps:
             limit = min(limit + config.dispatch_steps, scfg.max_steps)
-            state = advance_frontier(state, jnp.int32(limit), geom, scfg)
+            state = _advance(state, jnp.int32(limit), geom, scfg)
             dispatches[0] += 1
             if not bool(_any_live(state)):
                 break
@@ -384,13 +421,31 @@ def solve_bulk(
         ):
             jobs_per_chunk //= 2
         lanes = jobs_per_chunk * lanes_per_job
+        rung_lanes = -(-lanes // n_dev) * n_dev  # round up: lanes >= jobs
+        rung_impl = "xla"
+        want_fused = (
+            config.rung_step_impl == "fused"
+            or (
+                config.rung_step_impl is None
+                and jax.default_backend() == "tpu"
+            )
+        )
+        if want_fused and mesh is None:
+            from distributed_sudoku_solver_tpu.ops.pallas_step import (
+                max_fused_lanes,
+            )
+
+            if rung_lanes <= max_fused_lanes(n, slots):
+                rung_impl = "fused"
+                rung_lanes = -(-rung_lanes // 128) * 128
         scfg = SolverConfig(
-            lanes=-(-lanes // n_dev) * n_dev,  # round up: lanes >= jobs always
+            lanes=rung_lanes,
             stack_slots=slots,
             max_steps=rung_steps,
             max_sweeps=config.max_sweeps,
             propagator=prop,
             rules=config.rules,
+            step_impl=rung_impl,
             # Gang rungs (many thief lanes per job) need fast fan-out: one
             # steal pairing per step would ramp a gang up only linearly.
             steal_rounds=4 if lanes_per_job > 1 else 1,

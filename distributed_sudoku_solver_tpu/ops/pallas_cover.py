@@ -59,6 +59,7 @@ from distributed_sudoku_solver_tpu.models.cover import ExactCoverCSP, _unpack_bi
 from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
     _VMEM,
     _interpret_default,
+    _vmem_params,
 )
 
 _BIG = 1 << 22  # f32-exact sentinel for row/column argmin keys
@@ -571,24 +572,11 @@ def cover_fused_rounds(
     )
     # The default scoped-vmem limit (16 MB) is what multi-block instances
     # hit first — pentomino 6x10 missed it by 396 KB with everything else
-    # in place.  v5e carries far more physical VMEM than the conservative
-    # default; raise the ceiling and let the measured probes set the real
-    # admission boundary (benchmarks/probe_cover_kernel.py).
-    from jax.experimental.pallas import tpu as pltpu
-
-    params = (
-        {}
-        if interp
-        else {
-            "compiler_params": pltpu.CompilerParams(
-                vmem_limit_bytes=100 * 1024 * 1024
-            )
-        }
-    )
+    # in place (``pallas_propagate._vmem_params``).
     out_top, out_stack, out_sol, out_meta = pl.pallas_call(
         kernel,
         grid=(n_tiles,),
-        **params,
+        **_vmem_params(interp),
         in_specs=[
             *(const_spec(np.asarray(c)) for c in consts),
             lane_spec(d),

@@ -360,6 +360,26 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _vmem_params(interp: bool) -> dict:
+    """``pallas_call`` kwargs raising the scoped-vmem ceiling on TPU.
+
+    Mosaic's default scoped-VMEM limit is 16 MB — far below v5e's physical
+    VMEM — and it, not hardware, set several measured compile walls (the
+    cover kernel's multi-block OOM missed it by 396 KB; the Sudoku
+    kernel's ``_max_slots`` stack-depth caps were calibrated against it
+    in round 4).  Raising the ceiling lets the measured probes find the
+    real boundary instead of the default's."""
+    if interp:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        )
+    }
+
+
 def propagate_fixpoint_slices(
     cand: jax.Array, geom: Geometry, max_sweeps: int = 64, rules: str = "basic"
 ) -> tuple[jax.Array, jax.Array]:

@@ -127,7 +127,59 @@ def test_bulk_sharded_ragged_chunk_pads_evenly():
 
 
 def test_corrupt_values_stay_unsat_through_int8_wire():
+    """The nibble 15-marker path: 9x9 defaults to the dense format now,
+    so this pins the legacy packing explicitly (still the live format
+    for 10 <= n <= 14 geometries and the mesh branch)."""
+    from unittest import mock
+
+    from distributed_sudoku_solver_tpu.ops import wire
+
     bad = np.stack([EASY_9, EASY_9]).astype(np.int32)
     bad[1, 0, 0] = 257  # would wrap to a legal-looking 1 via a bare int8 cast
-    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2))
+    with mock.patch.object(wire, "best_format", return_value="packed"):
+        res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=2))
     assert res.solved[0] and not res.solved[1] and res.unsat[1]
+
+
+def test_fused_rungs_solve_and_fall_back_by_admission():
+    """Explicit fused rungs serve escalations correctly; a rung whose
+    stack depth the kernel cannot serve (S=256) silently falls back to
+    the composite step for that rung — verdicts identical either way."""
+    grids = _corpus(n_gen=28, n_clues=24)
+    shallow = BulkConfig(
+        chunk=32, stack_slots=2, first_pass_steps=4,
+        rungs=((64, 2, 8, 128), (64, 4, 256)),
+    )
+    import dataclasses
+
+    fused = dataclasses.replace(shallow, rung_step_impl="fused")
+    a = solve_bulk(grids, SUDOKU_9, shallow)
+    tr: dict = {}
+    b = solve_bulk(grids, SUDOKU_9, fused, trace=tr)
+    assert a.solved.all() and b.solved.all()
+    np.testing.assert_array_equal(a.solved, b.solved)
+    for s in b.solution:
+        assert is_valid_solution(s)
+    # first rung fused-admitted (lanes rounded to the 128 tile), second
+    # falls back: S=256 exceeds every measured compile boundary
+    assert tr["rungs"][0]["lanes"] % 128 == 0
+    if len(tr["rungs"]) > 1:
+        assert tr["rungs"][1]["slots"] == 256
+
+
+def test_dense_wire_bulk_matches_oracle():
+    """The dense (10-bit triplet) wire format is the 9x9 single-chip
+    default: solutions must match the oracle bit-for-bit and the corrupt
+    contract must hold without a wire code point."""
+    from distributed_sudoku_solver_tpu.ops import wire
+
+    assert wire.best_format(SUDOKU_9) == "dense"
+    grids = _corpus(n_gen=6)
+    bad = grids.copy()
+    bad[2, 0, 0] = -3
+    res = solve_bulk(bad, SUDOKU_9, BulkConfig(chunk=8))
+    assert res.unsat[2] and not res.solved[2]
+    ok = np.ones(len(bad), bool)
+    ok[2] = False
+    assert res.solved[ok].all()
+    assert np.array_equal(res.solution[0], solve_oracle(grids[0]))

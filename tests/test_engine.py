@@ -381,15 +381,18 @@ def test_fused_snapshot_and_resume_roots():
 
 
 def test_oversized_fused_group_splits_before_downgrading():
-    """A fused group wider than the kernel's widest serving width SPLITS
-    into fitting fused flights instead of downgrading: 9x9 at S=32 serves
-    whole-array tiles to 128 lanes (gridded 128-lane tiles don't compile),
-    so 130 jobs launch as two fused flights, zero downgrades."""
+    """Gate bands follow the round-5 measured compile table (the r4 caps
+    were artifacts of Mosaic's default scoped-vmem ceiling): 9x9 serves
+    gridded tiles to S=128 now, the whole-array-only clamp band lives at
+    14-16 S in (96, 128] and 25x25 S in (24, 48], and nothing fits 25x25
+    past S=48.  A wide fused group at an unbounded-width config launches
+    fused with zero downgrades."""
     from distributed_sudoku_solver_tpu.ops.pallas_step import max_fused_lanes
 
-    assert max_fused_lanes(9, 32) == 128  # whole-array only
-    assert max_fused_lanes(9, 12) == 1 << 30  # gridded tile fits
-    assert max_fused_lanes(16, 64) == 0  # nothing fits
+    assert max_fused_lanes(9, 32) == 1 << 30  # gridded fits since r5
+    assert max_fused_lanes(16, 128) == 128  # whole-array-only band
+    assert max_fused_lanes(25, 32) == 128  # whole-array-only band
+    assert max_fused_lanes(25, 64) == 0  # nothing fits
     cfg = SolverConfig(stack_slots=32, step_impl="fused", fused_steps=2)
     eng = SolverEngine(config=cfg, max_batch=256, max_flights=8).start()
     try:
@@ -403,9 +406,11 @@ def test_oversized_fused_group_splits_before_downgrading():
 
 
 def test_pinned_wide_fused_lanes_clamp_to_serving_width():
-    """A fused config pinning lanes above the serving width (9x9 S=32:
-    gridded doesn't compile, whole-array caps at 128) clamps to the cap
-    instead of downgrading — fused at 128 lanes beats composite at 256."""
+    """A pinned-wide fused config serves fused without downgrading.  At
+    9x9 S=32 the round-5 measured table admits gridded tiles, so 256
+    lanes fly as-is; the clamp band (whole-array-only widths) now lives
+    at 14-16 S in (96, 128] / 25x25 S in (24, 48] — its gate math is
+    asserted in test_oversized_fused_group_splits_before_downgrading."""
     cfg = SolverConfig(lanes=256, stack_slots=32, step_impl="fused", fused_steps=2)
     eng = SolverEngine(config=cfg, max_batch=8).start()
     try:
@@ -438,23 +443,24 @@ def test_packed_roots_fused_flight_clamps_like_grid_jobs():
 
 
 def test_fused_flight_vmem_misfit_downgrades_to_composite():
-    """A fused config whose kernel tile cannot fit scoped VMEM (16x16 at
-    deep stacks, beyond 128 lanes) downgrades the flight to the composite
-    step at launch: the job serves correctly, no error, and the downgrade
-    is counted on /metrics (VERDICT r4 #5 — a correct slower path exists,
-    so a tuning misfit must not error paying jobs)."""
+    """A fused config whose kernel tile cannot fit scoped VMEM (25x25 at
+    S=64 — past the round-5 measured whole-array cap of 48) downgrades
+    the flight to the composite step at launch: the job serves correctly,
+    no error, and the downgrade is counted on /metrics (VERDICT r4 #5 —
+    a correct slower path exists, so a tuning misfit must not error
+    paying jobs)."""
     from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
     from distributed_sudoku_solver_tpu.utils.puzzles import make_puzzle
 
-    g16 = geometry_for_size(16)
-    board = make_puzzle(g16, seed=7, n_clues=200, unique=False)  # propagation-easy
+    g25 = geometry_for_size(25)
+    board = make_puzzle(g25, seed=7, n_clues=545, unique=False)  # propagation-easy
     eng = SolverEngine(
         config=SolverConfig(lanes=256, stack_slots=64, step_impl="fused"),
         max_batch=8,
     ).start()
     try:
-        j = eng.submit(np.asarray(board, np.int32), geom=g16)
-        assert j.wait(120), j.error
+        j = eng.submit(np.asarray(board, np.int32), geom=g25)
+        assert j.wait(240), j.error
         assert j.error is None and j.solved, j.error
         assert eng.metrics()["fused_downgrades"] >= 1
         ok = eng.submit(EASY_9, config=SMALL)
