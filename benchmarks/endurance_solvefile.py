@@ -67,20 +67,38 @@ def sample_validate(
     from distributed_sudoku_solver_tpu import native
     from distributed_sudoku_solver_tpu.utils import dataset
 
-    with open(in_path, "rb") as f:
-        in_lines = f.read().splitlines()
-    with open(out_path, "rb") as f:
-        out_lines = f.read().splitlines()
-    # Tolerate a header line in the input (dataset.parse_boards does).
-    if len(in_lines) == len(out_lines) + 1:
-        in_lines = in_lines[1:]
-    assert len(in_lines) == len(out_lines), (
-        f"line mismatch: {len(in_lines)} in vs {len(out_lines)} out"
+    def count_lines(path: str) -> int:
+        n = 0
+        with open(path, "rb") as f:
+            for _ in f:
+                n += 1
+        return n
+
+    n_in, n_out = count_lines(in_path), count_lines(out_path)
+    header = 1 if n_in == n_out + 1 else 0  # tolerate an input header line
+    assert n_in - header == n_out, (
+        f"line mismatch: {n_in - header} in vs {n_out} out"
     )
     rng = np.random.default_rng(seed)
-    idx = rng.choice(len(out_lines), size=min(k, len(out_lines)), replace=False)
+    idx = set(
+        int(i) for i in rng.choice(n_out, size=min(k, n_out), replace=False)
+    )
+
+    def sample(path: str, skip: int) -> dict:
+        # Stream, keeping only the sampled lines: reading the whole 82 MB
+        # corpus into Python line lists every pass would inject hundreds
+        # of MB of transient heap right where the soak samples RSS.
+        out = {}
+        with open(path, "rb") as f:
+            for i, line in enumerate(f):
+                if i - skip in idx:
+                    out[i - skip] = line.rstrip(b"\n")
+        return out
+
+    in_lines = sample(in_path, header)
+    out_lines = sample(out_path, 0)
     ok = bad = zero = 0
-    for i in idx:
+    for i in sorted(idx):
         puzzle = dataset.parse_boards(in_lines[i], geom, allow_header=False)[0]
         sol = dataset.parse_boards(out_lines[i], geom, allow_header=False)[0]
         if not sol.any():

@@ -45,6 +45,10 @@ def run_matrix(grids, geom, cfgs: dict, repeat: int = 3) -> None:
             t0 = time.perf_counter()
             results[k] = solve_bulk(grids, geom, c, trace=tr)
             walls[k].append((time.perf_counter() - t0, tr))
+    # The documented invariant: a throughput row from an engine that did
+    # not solve the same corpus must never justify a default.
+    solved_counts = {k: int(r.solved.sum()) for k, r in results.items()}
+    assert len(set(solved_counts.values())) == 1, solved_counts
     for k in cfgs:
         best, tr = min(walls[k], key=lambda w: w[0])
         res = results[k]

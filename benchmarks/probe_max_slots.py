@@ -34,10 +34,11 @@ LADDER = [8, 12, 16, 20, 24, 32, 48, 64, 96, 128]
 
 
 # Box shapes per size: squares where possible, the tested rectangular
-# split otherwise; primes (11, 13) get degenerate 1 x n boxes (the box
-# unit collapses onto the row unit — still a valid, total CSP, and the
-# only way those sizes exist at all).
+# split otherwise; primes (5, 7, 11, 13) get degenerate 1 x n boxes (the
+# box unit collapses onto the row unit — still a valid, total CSP, and
+# the only way those sizes exist at all).
 BOXES = {
+    4: (2, 2), 5: (1, 5), 6: (2, 3), 7: (1, 7), 8: (2, 4),
     9: (3, 3), 10: (2, 5), 11: (1, 11), 12: (3, 4), 13: (1, 13),
     14: (2, 7), 15: (3, 5), 16: (4, 4), 25: (5, 5),
 }

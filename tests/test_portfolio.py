@@ -184,8 +184,9 @@ def test_fused_racer_misfit_downgrades_and_still_races():
     configs = [
         SolverConfig(min_lanes=4, stack_slots=16, max_steps=4096),
         SolverConfig(
-            min_lanes=4, stack_slots=16, max_steps=4096, step_impl="fused"
-        ),  # 25x25: no VMEM calibration point -> downgraded at launch
+            min_lanes=4, stack_slots=64, max_steps=4096, step_impl="fused"
+        ),  # 25x25 S=64: past the measured whole-array cap (48, round 5)
+        #    -> downgraded at launch (S=16 fits fused since round 5)
     ]
     eng = SolverEngine(max_flights=8).start()
     try:
