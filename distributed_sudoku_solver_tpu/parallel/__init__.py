@@ -17,4 +17,5 @@ from distributed_sudoku_solver_tpu.parallel.sharded import (  # noqa: F401
 )
 from distributed_sudoku_solver_tpu.parallel.fused_sharded import (  # noqa: F401
     solve_batch_fused_sharded,
+    solve_csp_fused_sharded,
 )

@@ -120,15 +120,25 @@ def _ring_steal_t(
 
 
 def _fused_round_sharded(
-    fs: FusedFrontier, geom: Geometry, config: SolverConfig, axis: str
+    fs: FusedFrontier,
+    geom: Geometry,
+    config: SolverConfig,
+    axis: str,
+    rounds_fn=None,
 ) -> FusedFrontier:
-    """One fused dispatch + local bookkeeping, then the cross-chip merges."""
+    """One fused dispatch + local bookkeeping, then the cross-chip merges.
+
+    ``rounds_fn`` swaps the whole-round kernel exactly as in
+    ``pallas_step._fused_round`` — the exact-cover kernel shards with the
+    same collectives (its states are [1, D] tensors; every merge below is
+    shape-generic)."""
     n_jobs = fs.solved.shape[0]
     n_dev = jax.lax.axis_size(axis)
     prev_solved = fs.solved
     prev_solution_t = fs.solution_t
 
-    fs = _fused_round(fs, geom, config)  # kernel + local harvest/purge/steal
+    # kernel + local harvest/purge/steal
+    fs = _fused_round(fs, geom, config, rounds_fn)
 
     # --- merge job resolution across chips (the SOLUTION_FOUND broadcast) ---
     newly = fs.solved & ~prev_solved
@@ -180,7 +190,11 @@ def _fused_round_sharded(
 
 
 def _run_fused_sharded(
-    state: Frontier, geom: Geometry, config: SolverConfig, axis: str
+    state: Frontier,
+    geom: Geometry,
+    config: SolverConfig,
+    axis: str,
+    rounds_fn=None,
 ) -> SolveResult:
     """Per-chip body: boards-last conversion, the solve loop, finalize psums."""
     fs = frontier_to_fused(state)
@@ -192,7 +206,9 @@ def _run_fused_sharded(
         )
 
     fs = jax.lax.while_loop(
-        cond, lambda f: _fused_round_sharded(f, geom, config, axis), fs
+        cond,
+        lambda f: _fused_round_sharded(f, geom, config, axis, rounds_fn),
+        fs,
     )
 
     n_jobs = fs.solved.shape[0]
@@ -228,6 +244,32 @@ def _run_fused_sharded(
     )
 
 
+def _sharded_body(mesh: Mesh, axis: str, geom, cfg, rounds_fn=None):
+    """The shard_map'd per-chip driver: lane-sharded state in, replicated
+    result out — one definition for the Sudoku and cover entry points."""
+    lane = lambda: P(axis)  # noqa: E731
+    lane_specs = Frontier(
+        top=lane(), has_top=lane(), stack=lane(), base=lane(), count=lane(),
+        job=lane(),
+        solved=P(), solution=P(), overflowed=P(), nodes=P(), sol_count=P(),
+        steps=P(), sweeps=P(), expansions=P(), steals=P(),
+    )
+    out_specs = SolveResult(
+        solution=P(), solved=P(), unsat=P(), overflowed=P(), nodes=P(),
+        sol_count=P(), steps=P(), sweeps=P(), expansions=P(), steals=P(),
+    )
+    return jax.shard_map(
+        functools.partial(
+            _run_fused_sharded, geom=geom, config=cfg, axis=axis,
+            rounds_fn=rounds_fn,
+        ),
+        mesh=mesh,
+        in_specs=(lane_specs,),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("geom", "config", "mesh"))
 def _solve_fused_sharded_jit(
     grids: jax.Array, geom: Geometry, config: SolverConfig, mesh: Mesh
@@ -243,25 +285,7 @@ def _solve_fused_sharded_jit(
     cfg = dataclasses.replace(config, lanes=per_chip * n_dev)
 
     state = init_frontier(encode_grid(grids, geom), cfg)
-
-    lane = lambda: P(axis)  # noqa: E731
-    lane_specs = Frontier(
-        top=lane(), has_top=lane(), stack=lane(), base=lane(), count=lane(),
-        job=lane(),
-        solved=P(), solution=P(), overflowed=P(), nodes=P(), sol_count=P(),
-        steps=P(), sweeps=P(), expansions=P(), steals=P(),
-    )
-    out_specs = SolveResult(
-        solution=P(), solved=P(), unsat=P(), overflowed=P(), nodes=P(),
-        sol_count=P(), steps=P(), sweeps=P(), expansions=P(), steals=P(),
-    )
-    body = jax.shard_map(
-        functools.partial(_run_fused_sharded, geom=geom, config=cfg, axis=axis),
-        mesh=mesh,
-        in_specs=(lane_specs,),
-        out_specs=out_specs,
-        check_vma=False,
-    )
+    body = _sharded_body(mesh, axis, geom, cfg)
     return _decode_solution(body(state))
 
 
@@ -276,3 +300,50 @@ def solve_batch_fused_sharded(
 
     mesh = mesh if mesh is not None else default_mesh()
     return _solve_fused_sharded_jit(jnp.asarray(grids), geom, config, mesh)
+
+
+@functools.partial(jax.jit, static_argnames=("problem", "config", "mesh"))
+def _solve_cover_fused_sharded_jit(
+    states0: jax.Array, problem, config: SolverConfig, mesh: Mesh
+) -> SolveResult:
+    from distributed_sudoku_solver_tpu.ops.pallas_cover import (
+        _rounds_fn,
+        cover_fused_lanes,
+    )
+
+    n_jobs = states0.shape[0]
+    (axis,) = mesh.axis_names
+    n_dev = mesh.devices.size
+
+    per_chip = -(-config.resolve_lanes(n_jobs) // n_dev)
+    per_chip = cover_fused_lanes(per_chip)
+    cfg = dataclasses.replace(config, lanes=per_chip * n_dev)
+
+    state = init_frontier(states0, cfg)
+    # One kernel-closure definition shared with the single-chip driver
+    # (pallas_cover._rounds_fn): per-chip shards are per_chip lanes wide.
+    body = _sharded_body(
+        mesh, axis, None, cfg, rounds_fn=_rounds_fn(problem, cfg, per_chip)
+    )
+    return body(state)  # raw cover states: no Sudoku decode
+
+
+def solve_csp_fused_sharded(
+    states0,
+    problem,
+    config: SolverConfig = SolverConfig(step_impl="fused"),
+    mesh: Mesh | None = None,
+) -> SolveResult:
+    """Fused exact-cover solve with lanes sharded over ``mesh``.
+
+    The cover kernel (``ops/pallas_cover.py``) under the same shard_map
+    composition as the Sudoku kernel: per-chip VMEM dispatches, psum
+    solution broadcast, ring-``ppermute`` steal, pmax-replicated step
+    counter.  Same contract as ``parallel.solve_csp_sharded`` (raw solved
+    states; exact psummed counts under ``count_all``)."""
+    from distributed_sudoku_solver_tpu.parallel.mesh import default_mesh
+
+    mesh = mesh if mesh is not None else default_mesh()
+    return _solve_cover_fused_sharded_jit(
+        jnp.asarray(states0), problem, config, mesh
+    )

@@ -191,3 +191,34 @@ def test_legacy_instances_without_incidence_raise_cleanly():
     )
     with pytest.raises(ValueError, match="incidence"):
         cover_consts(legacy)
+
+
+def test_fused_cover_sharded_on_mesh():
+    """The cover kernel under shard_map on the 8-device mesh: find-one
+    solves with a valid decode, and count_all psums disjoint per-chip
+    subtree counts to the exact total (6-queens: 4)."""
+    import dataclasses
+
+    from distributed_sudoku_solver_tpu.parallel import (
+        make_mesh,
+        solve_csp_fused_sharded,
+        solve_csp_sharded,
+    )
+
+    p = nqueens_cover(6)
+    mesh = make_mesh()
+    cfg = dataclasses.replace(FUSED, min_lanes=8 * 16)
+    res = solve_csp_fused_sharded(_roots(p), p, cfg, mesh=mesh)
+    assert bool(res.solved[0])
+    queens = decode_queens(p, np.asarray(res.solution[0]), 6)
+    assert is_valid_queens(queens, 6)
+
+    cnt_cfg = dataclasses.replace(cfg, count_all=True)
+    rf = solve_csp_fused_sharded(_roots(p), p, cnt_cfg, mesh=mesh)
+    rx = solve_csp_sharded(
+        _roots(p), p,
+        dataclasses.replace(XLA, min_lanes=8 * 16, count_all=True),
+        mesh=mesh,
+    )
+    assert int(rf.sol_count[0]) == int(rx.sol_count[0]) == 4
+    assert bool(rf.unsat[0]) and not bool(rf.overflowed[0])
