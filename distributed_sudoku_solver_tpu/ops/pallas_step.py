@@ -91,11 +91,9 @@ def _max_slots(n: int, whole_array: bool) -> int:
 
     * 4x4-13x13: S=128 compiles in BOTH tile modes — the probe's ladder
       max, recorded as the cap (deeper stacks than 128 deferred siblings
-      have no measured workload).  Probed points: 4, 6, 9, 10, 11, 12,
-      13 (incl. rectangular and degenerate 1 x n prime boxes); 5/7/8 are
-      inferred by monotonicity (their tree temporaries are strictly
-      smaller than 9x9's probed S=128) and probeable via
-      ``probe_max_slots.py --geoms 5,7,8``
+      have no measured workload).  Every point probed: 4-13 inclusive,
+      incl. rectangular (10, 12) and degenerate 1 x n prime boxes
+      (5, 7, 11, 13)
     * 14x14-16x16: whole-array S=128; gridded S=96 ok / S=128 OOM
     * 25x25: **whole-array S=48 / gridded S=24** — the geometry that
       "never fits" in rounds 3-4 now compiles and runs; the r4 caps
