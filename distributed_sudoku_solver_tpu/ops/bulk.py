@@ -84,9 +84,8 @@ class BulkConfig:
     rung_stack_mb: int = 768  # cap on a rung's stack tensor (lanes x slots)
     # First-pass step implementation: None = auto ('fused' whole-round VMEM
     # kernel on TPU, 3.45x the composite step device-only at 65,536 lanes —
-    # see BENCHMARKS.md round 4; 'xla' elsewhere).  Rungs always use the
-    # composite step: gang rungs live off steal reaction latency, which the
-    # fused path batches at fused_steps granularity.
+    # see BENCHMARKS.md round 4; 'xla' elsewhere).  The rung step engine
+    # is its own knob (``rung_step_impl`` below).
     step_impl: Optional[str] = None
     # Frontier rounds per fused dispatch on the first pass.  None = the
     # SolverConfig default (8).  The r4 device-resident re-sweep measured
