@@ -1,0 +1,177 @@
+"""Round-6 probe: the fused kernel's three named VPU losses, A/B'd in place.
+
+The round-4 roofline (BENCHMARKS.md) put the whole-round fused kernel at
+~0.23 T uint32-op/s — 6-12% of v5e VPU integer throughput — and named three
+losses: (a) the S-way masked-concat slot algebra ("mostly predication"),
+(b) short post-branch fixpoints amortizing the per-sweep loop machinery
+poorly, (c) `fused_steps` tuned for the tunnel surface on device-resident
+paths.  This probe measures each lever in isolation against the same
+device-resident corpus, with the interleaved-A/B discipline of
+``benchmarks/anatomy.py`` (sequential identical programs measure 17% apart
+through the tunnel — every ratio here alternates its sides).
+
+    python benchmarks/probe_fused_vpu.py              # all three levers
+    python benchmarks/probe_fused_vpu.py --lever slot # one lever
+    python benchmarks/probe_fused_vpu.py --check      # bit-equality only
+                                                      # (runs on the CPU mesh)
+
+On non-TPU backends the kernels run in Pallas interpret mode: the
+``--check`` lane (variant bit-equality) is meaningful there and runs in
+CI-ish time at --boards 64; wall-clock ratios are only meaningful on
+hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _legacy_select_slot(stack, sel_slot, active):
+    """The pre-round-6 masked-OR slot read, kept here as the A/B control:
+    S slot compares + S masking wheres + an OR fold (exclusive masks make
+    the fold exact) — the 'mostly predication' loss the mux tree replaces."""
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import _fold
+
+    s = stack.shape[0]
+    rows = [
+        jnp.where(active & (sel_slot == i), stack[i], jnp.uint32(0))
+        for i in range(s)
+    ]
+    import operator
+
+    return _fold(rows, operator.or_)
+
+
+def check_select_slot_equivalence(slots: int = 12, lanes: int = 128) -> None:
+    """Mux-tree select == legacy masked-OR select, for every slot index,
+    power-of-two or not (the circular stack visits all of [0, S))."""
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.ops.pallas_step import _select_slot
+
+    rng = np.random.default_rng(0)
+    for s in (1, 2, 3, 5, 6, 12, 13, 16):
+        stack = jnp.asarray(
+            rng.integers(0, 2**31, size=(s, 9, 9, lanes), dtype=np.uint32)
+        )
+        sel = jnp.asarray(
+            np.broadcast_to(
+                rng.integers(0, s, size=lanes).astype(np.int32),
+                (9, 9, lanes),
+            )
+        )
+        active = jnp.asarray(
+            np.broadcast_to(rng.integers(0, 2, size=lanes) > 0, (9, 9, lanes))
+        )
+        got = np.asarray(_select_slot(stack, sel, active))
+        want = np.asarray(_legacy_select_slot(stack, sel, active))
+        assert (got == want).all(), f"mux tree diverged at S={s}"
+    print(json.dumps({"check": "select_slot", "ok": True, "slots": slots}))
+
+
+def _corpus(n_boards: int):
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, puzzle_batch
+
+    distinct = puzzle_batch(
+        SUDOKU_9, max(0, n_boards - len(HARD_9)), seed=7, n_clues=24
+    )
+    return np.concatenate([np.stack(HARD_9), distinct])[:n_boards].astype(
+        np.int32
+    )
+
+
+def _timed_solve(grids, cfg, repeat: int = 3) -> tuple[float, object]:
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+    g = jnp.asarray(grids)
+    res = solve_batch(g, SUDOKU_9, cfg)  # warm the compile
+    int(np.asarray(res.steps))
+    best = float("inf")
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        res = solve_batch(g, SUDOKU_9, cfg)
+        int(np.asarray(res.steps))  # value fetch: the only trustworthy sync
+        best = min(best, time.perf_counter() - t0)
+    return best, res
+
+
+def probe_sweep_unroll(grids, repeat: int) -> dict:
+    """Lever (b): the unrolled fixpoint prefix.  Bit-exact by construction
+    (a sweep of a fixpoint is the identity) — assert it anyway, then time
+    prefix 0 (the pre-round-6 checked-every-sweep loop) vs 2, interleaved.
+    ``SolverConfig.fused_sweep_unroll`` is part of the jit key, so the two
+    arms compile separately."""
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+
+    out = {"lever": "sweep_unroll"}
+    for unroll in (0, 2, 0, 2):  # interleaved
+        cfg = SolverConfig(
+            step_impl="fused", stack_slots=12, rules="extended",
+            fused_sweep_unroll=unroll,
+        )
+        wall, res = _timed_solve(grids, cfg, repeat=max(1, repeat // 2))
+        key = f"unroll{unroll}"
+        out[key] = min(out.get(key, float("inf")), wall)
+        out[f"{key}_solved"] = int(np.asarray(res.solved).sum())
+    assert out["unroll0_solved"] == out["unroll2_solved"]
+    return out
+
+
+def probe_fused_steps(grids, repeat: int) -> dict:
+    """Lever (c): fused_steps on a device-resident solve (8 vs 32)."""
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+
+    out = {"lever": "fused_steps"}
+    for k in (8, 32, 8, 32):  # interleaved
+        cfg = SolverConfig(
+            step_impl="fused", stack_slots=12, rules="extended", fused_steps=k
+        )
+        wall, res = _timed_solve(grids, cfg, repeat=max(1, repeat // 2))
+        key = f"k{k}"
+        out[key] = min(out.get(key, float("inf")), wall)
+        out[f"{key}_solved"] = int(np.asarray(res.solved).sum())
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--boards", type=int, default=65536)
+    ap.add_argument("--repeat", type=int, default=4)
+    ap.add_argument(
+        "--lever", choices=("slot", "unroll", "steps", "all"), default="all"
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="bit-equality checks only (CPU-mesh friendly)",
+    )
+    args = ap.parse_args()
+
+    if args.check or args.lever in ("slot", "all"):
+        check_select_slot_equivalence()
+    if args.check:
+        return
+
+    grids = _corpus(args.boards)
+    if args.lever in ("unroll", "all"):
+        print(json.dumps(probe_sweep_unroll(grids, args.repeat)))
+    if args.lever in ("steps", "all"):
+        print(json.dumps(probe_fused_steps(grids, args.repeat)))
+
+
+if __name__ == "__main__":
+    main()

@@ -88,9 +88,18 @@ class BulkConfig:
     # is its own knob (``rung_step_impl`` below).
     step_impl: Optional[str] = None
     # Frontier rounds per fused dispatch on the first pass.  None = the
-    # SolverConfig default (8).  The r4 device-resident re-sweep measured
-    # 32 fastest (417k vs 359k boards/s) but e2e through the tunnel was a
-    # wash; benchmarks/anatomy.py re-probes it per surface (VERDICT r4 #1).
+    # per-chunk-transfer surface default (frontier.FUSED_STEPS_LINKED = 8):
+    # every first-pass chunk crosses the link, and 32 has never won the
+    # e2e A/B there across three rounds of measurement (r5 sweep: 8 ->
+    # 94k, 16 -> 80k, 32 -> 74k; the r4 and r6 sessions measured the same
+    # A/B a wash — 95.1 vs 94.0 and 96 vs 91 — so at best nothing, at
+    # worst 8's purge/steal reactivity pays on a transfer-bound pipeline).
+    # The r4 device-resident re-sweep measured
+    # 32 fastest (417k vs 359k boards/s), so the DEVICE-RESIDENT surfaces
+    # — engine flights, direct batch solves, meshes, and this pipeline's
+    # own escalation rungs (state stays on-device between their stepped
+    # dispatches) — resolve to 32 instead (frontier.FUSED_STEPS_DEVICE,
+    # BENCHMARKS.md "round 6: per-surface fused_steps").
     fused_steps: Optional[int] = None
     # Step engine for the escalation rungs.  None = auto: 'fused' on TPU
     # for any rung shape the kernel admits, 'xla' elsewhere.  The round-4
@@ -285,9 +294,12 @@ def solve_bulk(
             )
             else "xla"
         )
-    fused_kw = (
-        {} if config.fused_steps is None else {"fused_steps": config.fused_steps}
-    )
+    # The first pass is a per-chunk TRANSFER surface: resolve the shallow
+    # fused_steps default here rather than letting solve_batch_fused apply
+    # its device-resident deep default (rungs, which advance device-resident
+    # state via advance_frontier_fused, correctly get the deep one).
+    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_LINKED
+
     first_cfg = SolverConfig(
         lanes=chunk,
         stack_slots=config.stack_slots,
@@ -296,8 +308,8 @@ def solve_bulk(
         propagator=prop,
         rules=config.rules,
         step_impl=step_impl,
-        **fused_kw,
-    )
+        fused_steps=config.fused_steps,
+    ).with_fused_steps(FUSED_STEPS_LINKED)
 
     import time as _time
 
@@ -347,6 +359,7 @@ def solve_bulk(
         trace["first_pass_s"] = _time.perf_counter() - t_first
         trace["chunks"] = -(-b // chunk)
         trace["step_impl"] = step_impl
+        trace["fused_steps"] = first_cfg.fused_steps
         trace["remaining_after_first"] = int((~solved & ~unsat).sum())
         trace["rungs"] = []
 

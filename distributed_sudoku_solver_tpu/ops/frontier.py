@@ -58,6 +58,21 @@ import jax.numpy as jnp
 
 from distributed_sudoku_solver_tpu.ops.csp import CSProblem
 
+# Per-surface defaults for ``SolverConfig.fused_steps`` (rounds per fused
+# kernel dispatch), resolved by each entry point via
+# ``SolverConfig.with_fused_steps``.  The r4 device-resident re-sweep
+# measured 32 fastest (417k vs 359k boards/s at 8) while the e2e bulk A/B
+# through the tunnel went the other way (8 -> 94k vs 32 -> 74k: purge/steal
+# granularity costs reactivity and the pipeline is transfer-bound) — so the
+# default is a property of the SURFACE, not of the solver (BENCHMARKS.md
+# "round 6: per-surface fused_steps").
+FUSED_STEPS_DEVICE = 32  # device-resident: engine flights, direct batch
+#   solves, sharded meshes, bulk escalation rungs (state stays on-device
+#   between dispatches)
+FUSED_STEPS_LINKED = 8  # per-chunk transfer surfaces: the bulk first pass
+#   (every chunk crosses the link) — and the cover kernel on every surface
+#   (16/32 re-measured within noise there and declined, BENCHMARKS.md r5)
+
 
 @dataclasses.dataclass(frozen=True)
 class SolverConfig:
@@ -90,7 +105,17 @@ class SolverConfig:
     #   k-step dispatches, purge/steal at that granularity — sound, not
     #   bit-exact to 'xla'; serves batch solves AND engine flights via
     #   advance_frontier_fused; single-chip and lane-sharded meshes)
-    fused_steps: int = 8  # frontier rounds per fused-kernel dispatch
+    fused_steps: int | None = None  # frontier rounds per fused-kernel
+    #   dispatch; None = the calling surface's measured default
+    #   (FUSED_STEPS_DEVICE on device-resident paths, FUSED_STEPS_LINKED on
+    #   per-chunk transfer paths — resolved via ``with_fused_steps``)
+    fused_sweep_unroll: int = 2  # fixpoint sweeps run as a straight-line
+    #   prefix before the convergence-checked loop inside the fused kernel
+    #   (pallas_propagate._fixpoint_boards_last unroll): bit-exact (a sweep
+    #   of a fixpoint is the identity), amortizes the per-sweep loop
+    #   machinery over the 2-5-sweep post-branch fixpoints that dominate
+    #   after round 1; 0 = the pre-round-6 checked-every-sweep loop
+    #   (benchmarks/probe_fused_vpu.py A/Bs the two)
     steal: bool = True  # receiver-initiated work stealing between lanes
     steal_rounds: int = 1  # pairings per step; >1 ramps idle gangs up faster
     #   (a donor serves one thief per round, so a lone rich lane feeds at
@@ -105,10 +130,25 @@ class SolverConfig:
             raise ValueError(f"unknown step_impl {self.step_impl!r}")
         if self.step_impl == "fused" and self.branch_k != 2:
             raise ValueError("step_impl='fused' supports branch_k=2 only")
-        if self.fused_steps < 1:
+        if self.fused_steps is not None and self.fused_steps < 1:
             # 0 would make every fused dispatch a no-op: the driver's outer
             # while (any live & steps < max) then spins forever in-graph.
             raise ValueError(f"fused_steps must be >= 1, got {self.fused_steps}")
+        if self.fused_sweep_unroll < 0:
+            raise ValueError(
+                f"fused_sweep_unroll must be >= 0, got {self.fused_sweep_unroll}"
+            )
+
+    def with_fused_steps(self, surface_default: int) -> "SolverConfig":
+        """Resolve ``fused_steps=None`` to the calling surface's default.
+
+        Every fused entry point calls this with its surface constant
+        (``FUSED_STEPS_DEVICE`` / ``FUSED_STEPS_LINKED``) before the config
+        reaches a kernel dispatch; an explicit ``fused_steps`` always wins
+        (the portfolio's reactive fused racer pins 4, tests pin 2)."""
+        if self.fused_steps is not None:
+            return self
+        return dataclasses.replace(self, fused_steps=surface_default)
 
     def resolve_lanes(self, n_jobs: int) -> int:
         lanes = self.lanes if self.lanes > 0 else max(n_jobs, self.min_lanes)
@@ -145,6 +185,10 @@ class Frontier(NamedTuple):
     sweeps: jax.Array  # int32 scalar total propagation sweeps
     expansions: jax.Array  # int32 scalar total branch expansions
     steals: jax.Array  # int32 scalar total bottom-steals
+    lane_rounds: jax.Array  # int32[L] rounds each lane was LIVE (held a
+    #   working state for an unresolved job) — the occupancy counter behind
+    #   the /metrics fused_lane_occupancy histogram (ROADMAP 4b evidence);
+    #   maintained in-kernel by the fused path, per step by the composite
 
 
 def _seed_inverse(n_roots: int, n_lanes: int):
@@ -209,6 +253,7 @@ def init_frontier(states0: jax.Array, config: SolverConfig) -> Frontier:
         sweeps=jnp.int32(0),
         expansions=jnp.int32(0),
         steals=jnp.int32(0),
+        lane_rounds=jnp.zeros(n_lanes, jnp.int32),
     )
 
 
@@ -255,6 +300,7 @@ def init_frontier_roots(
         sweeps=jnp.int32(0),
         expansions=jnp.int32(0),
         steals=jnp.int32(0),
+        lane_rounds=jnp.zeros(n_lanes, jnp.int32),
     )
 
 
@@ -319,6 +365,7 @@ def init_frontier_packed(
         sweeps=jnp.int32(0),
         expansions=jnp.int32(0),
         steals=jnp.int32(0),
+        lane_rounds=jnp.zeros(n_lanes, jnp.int32),
     )
 
 
@@ -573,6 +620,7 @@ def frontier_step(
         sweeps=state.sweeps + sweeps,
         expansions=state.expansions + jnp.sum(undecided).astype(jnp.int32),
         steals=state.steals + n_steals,
+        lane_rounds=state.lane_rounds + live.astype(jnp.int32),
     )
 
 

@@ -67,6 +67,7 @@ _BIG = 1 << 22  # f32-exact sentinel for row/column argmin keys
 # meta rows: input state [8, T]; output state + per-dispatch deltas [16, T]
 _HAS, _BASE, _COUNT = 0, 1, 2
 _SOLVED, _OVER, _NODES, _SOLS, _SWEEPS, _STEPS = 3, 4, 5, 6, 7, 8
+_LIVE = 9  # rounds the lane held live work this dispatch (occupancy row)
 
 
 # Rows per in-kernel block.  A compile-boundary sweep on v5e (synthetic
@@ -488,7 +489,8 @@ def _cover_kernel(
                 sols_row,
                 meta[_SWEEPS : _SWEEPS + 1] + n_sweeps,
                 meta[_STEPS : _STEPS + 1] + 1,
-                jnp.zeros((16 - 9, t), jnp.int32),
+                meta[_LIVE : _LIVE + 1] + has,  # occupancy counter row
+                jnp.zeros((16 - 10, t), jnp.int32),
             ],
             axis=0,
         )
@@ -533,9 +535,10 @@ def cover_fused_rounds(
 ):
     """Advance every lane up to ``k_steps`` cover rounds in VMEM tiles.
 
-    Same 12-tuple contract as ``pallas_step.fused_rounds`` so the shared
-    XLA driver (``_fused_round``: harvest/purge/steal between dispatches)
-    serves both kernels unchanged."""
+    Same 13-tuple contract as ``pallas_step.fused_rounds`` (including the
+    per-lane live-rounds occupancy row) so the shared XLA driver
+    (``_fused_round``: harvest/purge/steal between dispatches) serves both
+    kernels unchanged."""
     n_lanes = top_t.shape[-1]
     d = top_t.shape[1]
     s = stack_t.shape[0]
@@ -617,6 +620,7 @@ def cover_fused_rounds(
         out_meta[_OVER] > 0,
         out_meta[_NODES],
         out_meta[_SOLS],
+        out_meta[_LIVE],
         sweeps_total,
         steps_max,
     )
@@ -642,13 +646,21 @@ def advance_cover_fused(state, step_limit: jax.Array, problem, config):
     lane-first generic frontier by fused dispatches until every job
     resolves or ``steps`` reaches ``step_limit`` (dynamic — the stepped
     drivers pass successive limits against one compiled program, keeping
-    each device dispatch wall-bounded for the watchdog discipline)."""
+    each device dispatch wall-bounded for the watchdog discipline).
+
+    The cover kernel keeps the shallow ``fused_steps`` default on EVERY
+    surface: the r5 re-measurement ran 16/32 within noise on both the
+    winning (queens) and losing (pentomino) rows, so the deep
+    device-resident default the Sudoku kernel adopted has no measured
+    payoff here (BENCHMARKS.md round 5)."""
+    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_LINKED
     from distributed_sudoku_solver_tpu.ops.pallas_step import (
         _run_fused,
         frontier_to_fused,
         fused_to_frontier,
     )
 
+    config = config.with_fused_steps(FUSED_STEPS_LINKED)
     limit = jnp.minimum(jnp.int32(step_limit), jnp.int32(config.max_steps))
     lanes = state.has_top.shape[0]
     fs = frontier_to_fused(state)
@@ -676,7 +688,10 @@ def solve_cover_fused(states0: jax.Array, problem: ExactCoverCSP, config):
     composite path."""
     import dataclasses
 
-    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier
+    from distributed_sudoku_solver_tpu.ops.frontier import (
+        FUSED_STEPS_LINKED,
+        init_frontier,
+    )
     from distributed_sudoku_solver_tpu.ops.solve import finalize_frontier
     from distributed_sudoku_solver_tpu.ops.pallas_step import (
         _run_fused,
@@ -684,6 +699,8 @@ def solve_cover_fused(states0: jax.Array, problem: ExactCoverCSP, config):
         fused_to_frontier,
     )
 
+    # Cover keeps the shallow default everywhere (see advance_cover_fused).
+    config = config.with_fused_steps(FUSED_STEPS_LINKED)
     n_jobs = states0.shape[0]
     lanes = cover_fused_lanes(config.resolve_lanes(n_jobs))
     config = dataclasses.replace(config, lanes=lanes)

@@ -193,6 +193,31 @@ def _unit_full(x: jax.Array, geom: Geometry, comb):
     return row, col, box
 
 
+def _unit_full_ot(x: jax.Array, geom: Geometry):
+    """(once, twice) unit reductions replicated over [n, n, T].
+
+    The sweep kernel's bits-seen->=1 / >=2 semiring (``_ot_comb``) over the
+    three unit views; one fold yields both what a plain OR family gives
+    (``once``) and the duplicate evidence (``twice``) — half the
+    slice/expand traffic of running an OR family and an int-sum family
+    separately, which is what :func:`status_full` used to do."""
+    from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
+        _expand,
+        _ot_comb,
+        _ot_lift,
+    )
+
+    n, bh, bw = geom.n, geom.box_h, geom.box_w
+    # _group_reduce/_expand tree-map over the (once, twice) pair leaves.
+    row = _expand(_group_reduce(_ot_lift(x), 1, n, _ot_comb), 1, n)
+    col = _expand(_group_reduce(_ot_lift(x), 0, n, _ot_comb), 0, n)
+    box = _group_reduce(
+        _group_reduce(_ot_lift(x), 0, bh, _ot_comb), 1, bw, _ot_comb
+    )
+    box = _expand(_expand(box, 0, bh), 1, bw)
+    return row, col, box
+
+
 def status_full(cand: jax.Array, geom: Geometry):
     """Mosaic twin of ``ops.propagate.board_status`` on [n, n, T].
 
@@ -201,25 +226,29 @@ def status_full(cand: jax.Array, geom: Geometry):
     ``(solved, contra)`` as int32 0/1 masks — int32 end to end, see
     :func:`_full_any_i` and :func:`_bcast_reduce` for the two Mosaic
     layout/lowering constraints that shape this code.
+
+    Round-6 restructure (the roofline's classify share): the duplicate
+    check rides the once/twice semiring (``twice != 0`` <=> some decided
+    singleton appears >= 2x in the unit <=> the old ``sum != or`` test —
+    exact, no int casts), and every per-cell badness condition ORs into ONE
+    mask before a single board any-reduce, instead of one
+    :func:`_full_any_i` (two reduce+expand families) per condition.  Two
+    materialized unit families and two board reductions, down from three
+    families and eight board reductions — bit-identical verdicts.
     """
     single = jax.lax.population_count(cand) == 1
     decided = jnp.where(single, cand, jnp.uint32(0))
     full = jnp.uint32(geom.full_mask)
 
-    bad = _full_any_i(jnp.where(cand == 0, 1, 0))  # empty cell
-    # Sum == OR iff the decided singleton masks in a unit are distinct
-    # (masks are <= 1 << 24 at n=25, so int32 sums cannot overflow).
-    d_int = decided.astype(jnp.int32)
-    for unit_or, unit_sum in zip(
-        _unit_full(decided, geom, _OR),
-        _unit_full(d_int, geom, operator.add),
+    bad_cell = cand == jnp.uint32(0)  # empty cell
+    for (_, twice), unit_or in zip(
+        _unit_full_ot(decided, geom), _unit_full(cand, geom, _OR)
     ):
-        bad = bad | _full_any_i(
-            jnp.where(unit_sum != unit_or.astype(jnp.int32), 1, 0)
-        )
-    for unit_or in _unit_full(cand, geom, _OR):
-        bad = bad | _full_any_i(jnp.where(unit_or != full, 1, 0))
+        # twice != 0: a decided digit duplicated in the unit; unit_or !=
+        # full: a digit with no home left in the unit.
+        bad_cell = bad_cell | (twice != jnp.uint32(0)) | (unit_or != full)
 
+    bad = _full_any_i(jnp.where(bad_cell, 1, 0))
     undecided_any = _full_any_i(jnp.where(single, 0, 1))
     contra = bad
     solved = jnp.where((undecided_any == 0) & (bad == 0), 1, 0)
@@ -263,20 +292,39 @@ def _highest_bit(x: jax.Array) -> jax.Array:
 
 
 def _select_slot(stack: jax.Array, sel_slot: jax.Array, active: jax.Array):
-    """Read stack[slot_l, :, :, l] per lane: OR of lane-masked static rows.
+    """Read stack[slot_l, :, :, l] per lane: log-depth multiplexer tree.
 
     ``sel_slot`` int32[n, n, T] (cell-uniform per-lane slot), ``active``
-    bool[n, n, T]; inactive lanes read 0.  Exclusive masks make the
-    OR-fold exact.
-    """
-    from distributed_sudoku_solver_tpu.ops.pallas_propagate import _fold
+    bool[n, n, T]; inactive lanes read 0.
 
+    Round-6 rewrite of the roofline's "mostly predication" loss: the old
+    form materialized S lane-masked rows (S slot compares + S masking
+    ``where``s + an S-1-node OR fold, all on [n, n, T] tiles).  The mux
+    tree instead selects pairwise on the BITS of ``sel_slot``: level k
+    pairs subtrees whose covered index ranges differ exactly in bit k
+    ([2j*2^k, (2j+1)*2^k) vs [(2j+1)*2^k, (2j+2)*2^k)), so one bit test
+    per level drives every pair at that level, and an odd tail node —
+    covering the aligned range [m*2^k, S) — is chosen at a later level
+    exactly when its bit says "upper half", which holds for every index in
+    the range.  Correct for any ``sel_slot`` in [0, S) at any S, powers of
+    two or not.  Cost: S-1 ``where``s + ceil(log2 S) bit tests vs the old
+    ~3S ops — the measured slot-read share of the round phases drops ~3x
+    (BENCHMARKS.md "round 6", probed by ``benchmarks/probe_fused_vpu.py``).
+    """
     s = stack.shape[0]
-    rows = [
-        jnp.where(active & (sel_slot == i), stack[i], jnp.uint32(0))
-        for i in range(s)
-    ]
-    return _fold(rows, _OR)
+    rows = [stack[i] for i in range(s)]
+    bit = 1
+    while len(rows) > 1:
+        take_hi = (sel_slot & bit) != 0
+        nxt = [
+            jnp.where(take_hi, rows[j + 1], rows[j])
+            for j in range(0, len(rows) - 1, 2)
+        ]
+        if len(rows) % 2:
+            nxt.append(rows[-1])
+        rows = nxt
+        bit <<= 1
+    return jnp.where(active, rows[0], jnp.uint32(0))
 
 
 def _write_slot(
@@ -284,14 +332,15 @@ def _write_slot(
 ) -> jax.Array:
     """Write ``row`` into stack[slot_l, :, :, l] per active lane.
 
-    Static-S concat tree (``.at[].set`` scatters don't lower in Mosaic)."""
+    Static-S concat (``.at[].set`` scatters don't lower in Mosaic).  Every
+    slot must be rewritten either way (the block is stored whole), so the
+    write stays O(S); the round-6 trim folds ``active`` into the slot key
+    ONCE (inactive lanes get key -1, matching no slot) instead of paying a
+    separate AND against ``active`` per slot."""
     s = stack.shape[0]
+    key = jnp.where(active, sel_slot, -1)
     parts = [
-        jnp.where(
-            (active & (sel_slot == i))[None],
-            row[None],
-            stack[i : i + 1],
-        )
+        jnp.where((key == i)[None], row[None], stack[i : i + 1])
         for i in range(s)
     ]
     return jnp.concatenate(parts, axis=0)
@@ -312,6 +361,7 @@ def _fused_kernel(
     out_over,
     out_nodes,
     out_solcnt,
+    out_live,
     out_sweeps,
     out_steps,
     out_sol,
@@ -322,6 +372,7 @@ def _fused_kernel(
     max_sweeps: int,
     k_steps: int,
     count_mode: bool,
+    sweep_unroll: int,
 ):
     """Run up to ``k_steps`` whole frontier rounds on one VMEM lane tile.
 
@@ -351,21 +402,25 @@ def _fused_kernel(
     overflow_f = jnp.zeros(shape, jnp.int32)
     nodes_d = jnp.zeros(shape, jnp.int32)
     sols_d = jnp.zeros(shape, jnp.int32)  # count_mode: solutions this dispatch
+    liv_d = jnp.zeros(shape, jnp.int32)  # rounds each lane was live (occupancy)
     sweeps_d = jnp.int32(0)
     steps_d = jnp.int32(0)
     pick_low = branch_rule != "minrem-desc"
 
     def cond(c):
         (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-         nodes_d, sols_d, sweeps_d, steps_d) = c
+         nodes_d, sols_d, liv_d, sweeps_d, steps_d) = c
         return jnp.any(has_top > 0) & (steps_d < k_steps)
 
     def body(c):
         (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-         nodes_d, sols_d, sweeps_d, steps_d) = c
+         nodes_d, sols_d, liv_d, sweeps_d, steps_d) = c
         live = has_top > 0
+        liv_d = liv_d + jnp.where(live, 1, 0)
         tops = jnp.where(live, top, jnp.uint32(0))
-        tops, n_sweeps = _fixpoint_boards_last(tops, geom, max_sweeps, rules)
+        tops, n_sweeps = _fixpoint_boards_last(
+            tops, geom, max_sweeps, rules, unroll=sweep_unroll
+        )
         slv, con = status_full(tops, geom)  # int32 0/1
         top_solved = (slv > 0) & live
         top_contra = (con > 0) & live
@@ -411,13 +466,13 @@ def _fused_kernel(
             )
         count = count + jnp.where(can_push, 1, 0) - jnp.where(can_pop, 1, 0)
         return (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-                nodes_d, sols_d, sweeps_d + n_sweeps, steps_d + 1)
+                nodes_d, sols_d, liv_d, sweeps_d + n_sweeps, steps_d + 1)
 
     (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-     nodes_d, sols_d, sweeps_d, steps_d) = jax.lax.while_loop(
+     nodes_d, sols_d, liv_d, sweeps_d, steps_d) = jax.lax.while_loop(
         cond, body,
         (top, stack, has_top, base, count, sol, solved_f, overflow_f,
-         nodes_d, sols_d, sweeps_d, steps_d),
+         nodes_d, sols_d, liv_d, sweeps_d, steps_d),
     )
 
     out_top[...] = top
@@ -432,15 +487,24 @@ def _fused_kernel(
     out_over[...] = overflow_f[0:1, 0:1]
     out_nodes[...] = nodes_d[0:1, 0:1]
     out_solcnt[...] = sols_d[0:1, 0:1]
+    out_live[...] = liv_d[0:1, 0:1]
     out_sweeps[...] = zero_row + sweeps_d
     out_steps[...] = zero_row + steps_d
+
+
+# Sweeps executed as a straight-line prefix before the convergence-checked
+# fixpoint loop inside the fused kernel (see _fixpoint_boards_last's
+# ``unroll``): after round 1 most tiles converge in 2-5 sweeps, so skipping
+# the loop machinery for the first two pays on nearly every round while the
+# prefix stays bit-exact (sweeping a fixpoint is the identity).
+_SWEEP_UNROLL = 2
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "geom", "rules", "branch_rule", "max_sweeps", "k_steps", "tile",
-        "count_mode", "interpret",
+        "count_mode", "interpret", "sweep_unroll",
     ),
 )
 def fused_rounds(
@@ -457,16 +521,20 @@ def fused_rounds(
     tile: int = 256,
     count_mode: bool = False,
     interpret: bool | None = None,
+    sweep_unroll: int = _SWEEP_UNROLL,
 ):
     """Advance every lane up to ``k_steps`` frontier rounds in VMEM tiles.
 
     Boards-last state: ``top_t`` uint32[n, n, L], ``stack_t`` uint32
     [S, n, n, L]; per-lane int32/bool vectors.  Returns ``(top_t, stack_t,
     has_top, base, count, lane_solved, lane_sol_t, lane_overflow,
-    nodes_delta, sols_delta, sweeps_total, steps_max)``.  With
-    ``count_mode`` (enumeration), solved lanes pop and continue instead of
-    freezing, and ``sols_delta`` counts every solved top; ``lane_solved`` /
-    ``lane_sol_t`` still report each lane's FIRST solution this dispatch.
+    nodes_delta, sols_delta, live_rounds_delta, sweeps_total, steps_max)``.
+    With ``count_mode`` (enumeration), solved lanes pop and continue
+    instead of freezing, and ``sols_delta`` counts every solved top;
+    ``lane_solved`` / ``lane_sol_t`` still report each lane's FIRST
+    solution this dispatch.  ``live_rounds_delta`` int32[L] counts the
+    in-kernel rounds each lane held live work — the per-dispatch occupancy
+    counter row behind ``/metrics fused_lane_occupancy`` (ROADMAP 4b).
     """
     n = geom.n
     n_lanes = top_t.shape[-1]
@@ -492,6 +560,7 @@ def fused_rounds(
         max_sweeps=max_sweeps,
         k_steps=k_steps,
         count_mode=count_mode,
+        sweep_unroll=sweep_unroll,
     )
     vmem = dict(memory_space=_VMEM) if (_VMEM is not None and not interp) else {}
     lane_spec = lambda *lead: pl.BlockSpec(  # noqa: E731
@@ -499,7 +568,7 @@ def fused_rounds(
     )
     row_shape = jax.ShapeDtypeStruct((1, 1, n_lanes), jnp.int32)
     (out_top, out_stack, o_has, o_base, o_cnt, o_solved, o_over, o_nodes,
-     o_solcnt, o_sweeps, o_steps, out_sol) = pl.pallas_call(
+     o_solcnt, o_live, o_sweeps, o_steps, out_sol) = pl.pallas_call(
         kernel,
         grid=(n_tiles,),
         in_specs=[
@@ -512,13 +581,13 @@ def fused_rounds(
         out_specs=(
             lane_spec(n, n),
             lane_spec(s, n, n),
-            *([lane_spec(1, 1)] * 9),
+            *([lane_spec(1, 1)] * 10),
             lane_spec(n, n),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(top_t.shape, jnp.uint32),
             jax.ShapeDtypeStruct(stack_t.shape, jnp.uint32),
-            *([row_shape] * 9),
+            *([row_shape] * 10),
             jax.ShapeDtypeStruct(top_t.shape, jnp.uint32),
         ),
         interpret=interp,
@@ -540,6 +609,7 @@ def fused_rounds(
         o_over[0, 0] > 0,
         o_nodes[0, 0],
         o_solcnt[0, 0],
+        o_live[0, 0],
         sweeps_total,
         steps_max,
     )
@@ -568,6 +638,7 @@ class FusedFrontier(NamedTuple):
     sweeps: jax.Array  # int32
     expansions: jax.Array  # int32
     steals: jax.Array  # int32
+    lane_rounds: jax.Array  # int32[L] rounds each lane was live (occupancy)
 
 
 def frontier_to_fused(state) -> FusedFrontier:
@@ -593,6 +664,7 @@ def frontier_to_fused(state) -> FusedFrontier:
         sweeps=state.sweeps,
         expansions=state.expansions,
         steals=state.steals,
+        lane_rounds=state.lane_rounds,
     )
 
 
@@ -616,6 +688,7 @@ def fused_to_frontier(fs: FusedFrontier):
         sweeps=fs.sweeps,
         expansions=fs.expansions,
         steals=fs.steals,
+        lane_rounds=fs.lane_rounds,
     )
 
 
@@ -694,7 +767,7 @@ def _fused_round(
 ) -> FusedFrontier:
     """One kernel dispatch (k_steps rounds) + the XLA-side job bookkeeping.
 
-    ``rounds_fn`` (FusedFrontier -> the 12-tuple :func:`fused_rounds`
+    ``rounds_fn`` (FusedFrontier -> the 13-tuple :func:`fused_rounds`
     returns) swaps in a different whole-round kernel — the exact-cover
     kernel (``ops/pallas_cover.py``) shares every piece of this job
     bookkeeping (harvest, purge, steal) by providing its own; ``None``
@@ -715,9 +788,10 @@ def _fused_round(
             # lanes use one full-array tile, beyond that 128-lane tiles.
             tile=min(128, n_lanes),
             count_mode=config.count_all,
+            sweep_unroll=config.fused_sweep_unroll,
         )
     (top_t, stack_t, has_top, base, count, lane_solved, lane_sol_t,
-     lane_over, nodes_d, sols_d, sweeps_t, steps_m) = rounds_fn(fs)
+     lane_over, nodes_d, sols_d, liv_d, sweeps_t, steps_m) = rounds_fn(fs)
 
     live_jobs = fs.job >= 0
     lane_ids = jnp.arange(n_lanes, dtype=jnp.int32)
@@ -787,6 +861,7 @@ def _fused_round(
         sweeps=fs.sweeps + sweeps_t,
         expansions=fs.expansions + jnp.sum(nodes_d),
         steals=fs.steals + n_steals,
+        lane_rounds=fs.lane_rounds + liv_d,
     )
 
 
@@ -836,7 +911,15 @@ def advance_frontier_fused(
 
     The caller must have sized the frontier with :func:`fused_lanes`
     (lane counts beyond 128 must be multiples of 128).
+
+    A device-resident surface: the frontier never crosses the link between
+    dispatches, so ``fused_steps=None`` resolves to the deep default
+    (``FUSED_STEPS_DEVICE`` — r4 re-sweep: 32 measured +16% device-only
+    over 8; the reactivity cost only matters where chunks cross a link).
     """
+    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_DEVICE
+
+    config = config.with_fused_steps(FUSED_STEPS_DEVICE)
     limit = jnp.minimum(jnp.int32(step_limit), jnp.int32(config.max_steps))
     fs = frontier_to_fused(state)
     fs = _run_fused(fs, geom, config, limit)
@@ -868,11 +951,19 @@ def solve_batch_fused(
     import dataclasses
 
     from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
-    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier
+    from distributed_sudoku_solver_tpu.ops.frontier import (
+        FUSED_STEPS_DEVICE,
+        init_frontier,
+    )
     from distributed_sudoku_solver_tpu.ops.solve import (
         SolveResult,
         _decode_solution,
     )
+
+    # Device-resident surface: grids stay on-device across dispatches, so
+    # fused_steps=None resolves to the deep default (see
+    # advance_frontier_fused).
+    config = config.with_fused_steps(FUSED_STEPS_DEVICE)
 
     # Round the lane count up to a multiple of the kernel tile so the
     # grid divides evenly — the composite path has no such constraint, and

@@ -61,7 +61,7 @@ from distributed_sudoku_solver_tpu.ops.frontier import (
     run_frontier,
 )
 from distributed_sudoku_solver_tpu.ops.solve import SolveResult, finalize_frontier
-from distributed_sudoku_solver_tpu.parallel.mesh import make_mesh
+from distributed_sudoku_solver_tpu.parallel.mesh import shard_map as _shard_map_compat, make_mesh
 
 # Mesh axis the board's row-band dimension is sharded over.
 BAND_AXIS = "bands"
@@ -503,8 +503,9 @@ def _solve_banded_jit(
         sweeps=P(),
         expansions=P(),
         steals=P(),
+        lane_rounds=P(),
     )
-    body = jax.shard_map(
+    body = _shard_map_compat(
         functools.partial(run_frontier, problem=problem, config=config),
         mesh=mesh,
         in_specs=(specs,),

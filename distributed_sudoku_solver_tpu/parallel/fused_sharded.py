@@ -52,6 +52,10 @@ from distributed_sudoku_solver_tpu.ops.pallas_step import (
     fused_lanes,
 )
 from distributed_sudoku_solver_tpu.ops.solve import SolveResult, _decode_solution
+from distributed_sudoku_solver_tpu.parallel.mesh import (
+    axis_size as _axis_size_compat,
+    shard_map as _shard_map_compat,
+)
 
 
 def _ring_steal_t(
@@ -74,7 +78,7 @@ def _ring_steal_t(
     ships; the receiver's idle count cannot have shrunk — the local steal
     already ran this round and nothing else touches it).
     """
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = _axis_size_compat(axis)
     n_lanes = has_top.shape[0]
     s = stack_t.shape[0]
     k = min(k, n_lanes)
@@ -133,7 +137,7 @@ def _fused_round_sharded(
     same collectives (its states are [1, D] tensors; every merge below is
     shape-generic)."""
     n_jobs = fs.solved.shape[0]
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = _axis_size_compat(axis)
     prev_solved = fs.solved
     prev_solution_t = fs.solution_t
 
@@ -253,12 +257,13 @@ def _sharded_body(mesh: Mesh, axis: str, geom, cfg, rounds_fn=None):
         job=lane(),
         solved=P(), solution=P(), overflowed=P(), nodes=P(), sol_count=P(),
         steps=P(), sweeps=P(), expansions=P(), steals=P(),
+        lane_rounds=lane(),
     )
     out_specs = SolveResult(
         solution=P(), solved=P(), unsat=P(), overflowed=P(), nodes=P(),
         sol_count=P(), steps=P(), sweeps=P(), expansions=P(), steals=P(),
     )
-    return jax.shard_map(
+    return _shard_map_compat(
         functools.partial(
             _run_fused_sharded, geom=geom, config=cfg, axis=axis,
             rounds_fn=rounds_fn,
@@ -278,6 +283,11 @@ def _solve_fused_sharded_jit(
     (axis,) = mesh.axis_names
     n_dev = mesh.devices.size
 
+    # Device-resident surface: shards live on their chips between
+    # dispatches, so fused_steps=None resolves deep (FUSED_STEPS_DEVICE).
+    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_DEVICE
+
+    config = config.with_fused_steps(FUSED_STEPS_DEVICE)
     # Each chip's lane block must itself be a kernel-valid width (<= 128, or
     # a multiple of 128) — size per-chip first, then scale by the mesh.
     per_chip = -(-config.resolve_lanes(n_jobs) // n_dev)
@@ -315,6 +325,11 @@ def _solve_cover_fused_sharded_jit(
     (axis,) = mesh.axis_names
     n_dev = mesh.devices.size
 
+    # Cover keeps the shallow fused_steps default on every surface
+    # (ops/pallas_cover.advance_cover_fused).
+    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_LINKED
+
+    config = config.with_fused_steps(FUSED_STEPS_LINKED)
     per_chip = -(-config.resolve_lanes(n_jobs) // n_dev)
     per_chip = cover_fused_lanes(per_chip)
     cfg = dataclasses.replace(config, lanes=per_chip * n_dev)

@@ -31,3 +31,39 @@ def make_mesh(
 
 def default_mesh() -> Mesh:
     return make_mesh()
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a mesh axis from inside a ``shard_map`` body.
+
+    ``jax.lax.axis_size`` only exists on newer jax; older runtimes expose
+    the same static int as ``jax.core.axis_frame(name)``.  Same shim
+    rationale as :func:`shard_map` below."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    # 0.4.37-era jax returns the int directly; slightly older versions
+    # return a frame object carrying it as .size.
+    return getattr(frame, "size", frame)
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across the jax versions this repo meets.
+
+    The top-level ``jax.shard_map`` (and its ``check_vma`` keyword) only
+    exist on newer jax; older jaxlibs ship it as
+    ``jax.experimental.shard_map.shard_map`` with the keyword spelled
+    ``check_rep``.  Every ``shard_map`` call site in ``parallel/`` routes
+    through this one shim so the whole multi-chip tier degrades gracefully
+    instead of dying with ``AttributeError`` on the older runtime."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

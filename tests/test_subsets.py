@@ -99,25 +99,16 @@ def test_overfull_subset_is_a_contradiction():
 
 
 @pytest.mark.parametrize("size", [9, 12, 16])
-def test_subsets_sound_and_stronger(size):
+def test_subsets_sound_and_stronger(size, heavy_compile_guard):
     """On solvable boards: 'subsets' masks are a subset of 'extended' masks
     (strictly stronger inference) and never delete the true digit.  12x12
-    exercises rectangular (3x4) boxes."""
-    import jax
+    exercises rectangular (3x4) boxes.
 
+    The giant-geometry subsets-sweep compile is the largest single XLA:CPU
+    compilation in the suite — ``heavy_compile_guard`` (conftest.py, where
+    the segfault hazard is documented) drops accumulated executables first
+    when the process is crowded."""
     from distributed_sudoku_solver_tpu.models.geometry import Geometry
-
-    # The giant-geometry subsets-sweep compile is the largest single
-    # XLA:CPU compilation in the suite, and late in a full run — with a
-    # few hundred compiled executables resident in this process — the
-    # native compiler segfaulted here twice on 2026-07-31 (passes in
-    # isolation and in fresh processes every time).  Dropping the
-    # accumulated executables before the heavy compile removes the
-    # allocator pressure that correlates with the crash; once, before
-    # the largest compile only (the 9/12 passes should keep their own
-    # freshly-built executables).
-    if size == 16:
-        jax.clear_caches()
 
     geom = Geometry(3, 4) if size == 12 else geometry_for_size(size)
     if size == 9:
