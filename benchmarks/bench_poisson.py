@@ -1,0 +1,199 @@
+"""Poisson-arrival serving benchmark: resident flights vs static flights.
+
+The round-7 acceptance measurement (ISSUE: continuous-batching resident
+flights).  A Poisson arrival process with mean inter-arrival BELOW the
+single-flight duration is fired at two engines built identically except for
+the scheduler:
+
+* **static**: today's flight loop — each admitted batch launches its own
+  frontier and retires whole; an arrival during a full house waits for a
+  flight to drain.
+* **resident**: the continuous-batching scheduler
+  (``serving/scheduler.py``) — one long-lived frontier; arrivals attach to
+  recycled job slots between dispatches.
+
+Reported: per-job time-to-solution p50/p95/p99 for both, plus the
+improvement ratios.  ``--handicap-ms`` applies the engine's per-chunk
+slow-node simulator to BOTH engines; it stands in for the real
+per-dispatch floor (RPC tunnel ~100 ms, device dispatch overhead) that the
+CPU test container otherwise hides — the resident flight's claim is
+exactly that ONE dispatch serves every tenant where the static path pays
+the floor per flight.  ``--handicap-ms 0`` measures the raw CPU
+compute-bound case too.
+
+Run: ``python benchmarks/bench_poisson.py [--jobs 48] [--mean-ms 50]
+[--handicap-ms 50] [--json]``.  The tier-1 smoke and the ``slow``-marked
+assertion live in ``tests/test_scheduler.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from any cwd without installing
+    sys.path.insert(0, REPO)
+
+
+def _percentiles(lats) -> dict:
+    arr = np.asarray(sorted(lats), float)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 1),
+        "p95_ms": round(float(np.percentile(arr, 95)) * 1e3, 1),
+        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 1),
+        "mean_ms": round(float(arr.mean()) * 1e3, 1),
+        "jobs": len(lats),
+    }
+
+
+def poisson_load(engine, boards, mean_gap_s: float, seed: int = 0,
+                 timeout: float = 600.0):
+    """Submit ``boards`` with exponential inter-arrival gaps; returns
+    ``(latencies_s, jobs)`` where latency is submit -> resolution wall
+    (inf for a job that missed ``timeout``)."""
+    rng = random.Random(seed)
+    jobs: list = []
+    lats = [float("inf")] * len(boards)
+    threads = []
+
+    def waiter(i, job):
+        if job.wait(timeout):
+            lats[i] = time.monotonic() - job.submitted_at
+
+    for i, board in enumerate(boards):
+        job = engine.submit(np.asarray(board, np.int32))
+        jobs.append(job)
+        t = threading.Thread(target=waiter, args=(i, job), daemon=True)
+        t.start()
+        threads.append(t)
+        if i + 1 < len(boards):
+            time.sleep(rng.expovariate(1.0 / mean_gap_s))
+    for t in threads:
+        t.join(timeout)
+    return lats, jobs
+
+
+def _corpus(n_jobs: int):
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    return [np.asarray(HARD_9[i % len(HARD_9)]) for i in range(n_jobs)]
+
+
+def compare_poisson(
+    n_jobs: int = 48,
+    mean_gap_s: float = 0.05,
+    handicap_s: float = 0.05,
+    seed: int = 7,
+    chunk_steps: int = 8,
+) -> dict:
+    """One A/B: identical arrival schedule against a static-flight engine
+    and a resident-flight engine (same solver config, same chunk
+    granularity, same handicap)."""
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.serving.scheduler import ResidentConfig
+
+    cfg = SolverConfig(min_lanes=8, stack_slots=16)
+    boards = _corpus(n_jobs)
+    out: dict = {
+        "jobs": n_jobs,
+        "mean_gap_ms": mean_gap_s * 1e3,
+        "handicap_ms": handicap_s * 1e3,
+    }
+
+    static = SolverEngine(
+        config=cfg, max_batch=8, handicap_s=handicap_s, chunk_steps=chunk_steps
+    ).start()
+    try:
+        # Warm the compile caches so both sides measure scheduling, not XLA.
+        w = static.submit(boards[0])
+        assert w.wait(300)
+        lats, jobs = poisson_load(static, boards, mean_gap_s, seed)
+        assert all(j.solved for j in jobs), "static baseline failed a job"
+        out["static"] = _percentiles(lats)
+    finally:
+        static.stop(timeout=2)
+
+    resident = SolverEngine(
+        config=cfg,
+        max_batch=8,
+        handicap_s=handicap_s,
+        chunk_steps=chunk_steps,
+        resident=ResidentConfig(
+            job_slots=8,
+            gang_lanes=4,
+            queue_depth=max(16, n_jobs),
+            attach_batch=8,
+            chunk_steps=chunk_steps,
+        ),
+    ).start()
+    try:
+        w = resident.submit(boards[0])
+        assert w.wait(300)
+        lats, jobs = poisson_load(resident, boards, mean_gap_s, seed)
+        assert all(j.solved for j in jobs), "resident engine failed a job"
+        out["resident"] = _percentiles(lats)
+        out["resident_metrics"] = resident.metrics()["resident"]["9x9"]
+    finally:
+        resident.stop(timeout=2)
+
+    for q in ("p50_ms", "p95_ms", "p99_ms"):
+        if out["resident"][q] > 0:
+            out[f"speedup_{q[:-3]}"] = round(
+                out["static"][q] / out["resident"][q], 2
+            )
+    return out
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--jobs", type=int, default=48)
+    ap.add_argument("--mean-ms", type=float, default=50.0)
+    ap.add_argument("--handicap-ms", type=float, default=50.0)
+    ap.add_argument("--chunk-steps", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    out = compare_poisson(
+        n_jobs=args.jobs,
+        mean_gap_s=args.mean_ms / 1e3,
+        handicap_s=args.handicap_ms / 1e3,
+        seed=args.seed,
+        chunk_steps=args.chunk_steps,
+    )
+    if args.json:
+        print(json.dumps(out))
+        return
+    print(
+        f"Poisson load: {out['jobs']} jobs, mean gap "
+        f"{out['mean_gap_ms']:.0f} ms, per-chunk handicap "
+        f"{out['handicap_ms']:.0f} ms"
+    )
+    print(f"{'':<10}{'p50 ms':>10}{'p95 ms':>10}{'p99 ms':>10}{'mean ms':>10}")
+    for name in ("static", "resident"):
+        r = out[name]
+        print(
+            f"{name:<10}{r['p50_ms']:>10}{r['p95_ms']:>10}"
+            f"{r['p99_ms']:>10}{r['mean_ms']:>10}"
+        )
+    print(
+        "speedup    p50 x{sp50}  p95 x{sp95}  p99 x{sp99}".format(
+            sp50=out.get("speedup_p50"),
+            sp95=out.get("speedup_p95"),
+            sp99=out.get("speedup_p99"),
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,33 @@ def build_parser() -> argparse.ArgumentParser:
         "minrem-desc = MRV with descending digit order, the portfolio mirror)",
     )
     ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument(
+        "--no-resident",
+        action="store_true",
+        help="disable the continuous-batching resident flight (serving/"
+        "scheduler.py); /solve then never answers 429 and every job runs "
+        "in a static flight",
+    )
+    ap.add_argument(
+        "--resident-slots",
+        type=int,
+        default=16,
+        help="resident job slots per geometry (concurrent jobs packed into "
+        "one long-lived frontier)",
+    )
+    ap.add_argument(
+        "--resident-gang",
+        type=int,
+        default=8,
+        help="lanes per resident job slot (per-job speculation width)",
+    )
+    ap.add_argument(
+        "--resident-queue",
+        type=int,
+        default=64,
+        help="resident admission-queue bound; beyond it /solve answers "
+        "429 + Retry-After",
+    )
     ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")
     ap.add_argument("--heartbeat-s", type=float, default=1.0)
     ap.add_argument(
@@ -93,11 +120,24 @@ def make_engine(args) -> SolverEngine:
         from distributed_sudoku_solver_tpu.parallel import solve_batch_sharded
 
         solve_fn = lambda grids, geom, c: solve_batch_sharded(grids, geom, c)  # noqa: E731
+    resident = None
+    if not args.no_resident and solve_fn is None:
+        # Continuous batching is on by default for serving nodes (the
+        # sharded solve_fn override keeps the legacy one-dispatch path and
+        # has no flight loop to host a resident frontier).
+        from distributed_sudoku_solver_tpu.serving.scheduler import ResidentConfig
+
+        resident = ResidentConfig(
+            job_slots=args.resident_slots,
+            gang_lanes=args.resident_gang,
+            queue_depth=args.resident_queue,
+        )
     return SolverEngine(
         config=cfg,
         max_batch=args.max_batch,
         solve_fn=solve_fn,
         handicap_s=args.handicap / 1000.0,
+        resident=resident,
     )
 
 

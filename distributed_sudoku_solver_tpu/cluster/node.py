@@ -418,6 +418,10 @@ class ClusterNode:
         # (VERDICT r5 missing #3) — now counted, logged, and exported on
         # /metrics so an operator can see which deployments run resumeless.
         self.progress_skipped = 0
+        # Jobs served by a resident flight run without progress streaming
+        # at all (no snapshot surface): counted so an operator can see how
+        # much of the fleet's work resumes from the root on a death.
+        self.progress_resident = 0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -696,15 +700,26 @@ class ClusterNode:
         job_uuid: Optional[str] = None,
         base_nodes: int = 0,
         config=None,
+        saturation: str = "fallback",
     ) -> _Exec:
         """Run a job (or subtree part) on the local engine under an _Exec
-        aggregate; ``on_final`` fires exactly once with the merged result."""
+        aggregate; ``on_final`` fires exactly once with the merged result.
+
+        ``saturation`` is forwarded to ``engine.submit`` for grid jobs:
+        client-facing dispatches (the HTTP ``/solve`` path through
+        :meth:`submit`) pass ``'reject'`` so a saturated resident flight
+        backpressures with 429 + Retry-After; internal re-dispatches
+        (peer TASKs, failure re-execution, shed parts) keep the quiet
+        static-flight fallback — work already accepted by the cluster must
+        never bounce."""
         if roots is not None:
             ej = self.engine.submit_roots(
                 roots, geom, job_uuid=job_uuid, config=config
             )
         else:
-            ej = self.engine.submit(grid, job_uuid=job_uuid, config=config)
+            ej = self.engine.submit(
+                grid, job_uuid=job_uuid, config=config, saturation=saturation
+            )
 
         def wrapped(result: dict) -> None:
             with self._lock:
@@ -755,7 +770,12 @@ class ClusterNode:
             raise ValueError(f"grid must be square, got {g.shape}")
         member = self._pick_member()
         if member == self.addr_s:
-            return self._submit_local(g, config=config)
+            # Client-facing dispatch: a saturated local resident flight
+            # rejects (EngineSaturated -> HTTP 429 + Retry-After) instead
+            # of quietly growing an unbounded queue.  Remote dispatch has
+            # no cross-wire backpressure: the TASK lands in the member's
+            # static path if its resident flight is full.
+            return self._submit_local(g, config=config, saturation="reject")
         return self._submit_remote(g, member, config=config)
 
     def race(self, grid, configs, timeout: Optional[float] = None):
@@ -813,7 +833,9 @@ class ClusterNode:
         with self._lock:
             self._outstanding[member] = self._outstanding.get(member, 0) + delta
 
-    def _submit_local(self, g: np.ndarray, config=None) -> Job:
+    def _submit_local(
+        self, g: np.ndarray, config=None, saturation: str = "fallback"
+    ) -> Job:
         geom = geometry_for_size(g.shape[0])
         ju = str(uuid_mod.uuid4())
         handle = Job(uuid=ju, grid=g, geom=geom)
@@ -824,7 +846,9 @@ class ClusterNode:
             self._apply_result(handle, r)
 
         try:
-            self._start_exec(fin, grid=g, job_uuid=ju, config=config)
+            self._start_exec(
+                fin, grid=g, job_uuid=ju, config=config, saturation=saturation
+            )
         except BaseException:
             # submit can raise (e.g. "engine stopped"); un-count or the +1
             # leaks and permanently skews least-outstanding placement.
@@ -954,6 +978,15 @@ class ClusterNode:
         while not self._stop.is_set() and not ex.finalized:
             time.sleep(self.config.progress_interval_s)
             if ex.finalized:
+                return
+            if self.engine.job_is_resident(ex.uuid):
+                # Resident-flight jobs (serving/scheduler.py) have no
+                # snapshot surface: a death here resumes from the root via
+                # the origin's ledger copy.  Degrade VISIBLY (the same
+                # counter the over-cap snapshot path uses) instead of
+                # polling a permanent None every interval.
+                with self._lock:
+                    self.progress_resident += 1
                 return
             snap = self.engine.snapshot_rows(ex.uuid, timeout=2.0)
             if snap is None:
@@ -1240,6 +1273,10 @@ class ClusterNode:
                 # nonzero means some jobs here run with degraded (root-only)
                 # resume — VERDICT r5 missing #3 made visible.
                 "progress_skipped": self.progress_skipped,
+                # Jobs served resident (continuous batching) run without
+                # progress streaming; slot occupancy / admission waits /
+                # rejects ride the engine body's "resident" section.
+                "progress_resident": self.progress_resident,
             }
         return body
 

@@ -102,13 +102,27 @@ class BulkConfig:
     # BENCHMARKS.md "round 6: per-surface fused_steps").
     fused_steps: Optional[int] = None
     # Step engine for the escalation rungs.  None = auto: 'fused' on TPU
-    # for any rung shape the kernel admits, 'xla' elsewhere.  The round-4
-    # rationale for composite-only rungs ("gang rungs live off steal
-    # reaction latency") was measured wrong where it matters: the fused
-    # gang rung took the deep-25x25 row 5.6 -> 20-24 boards/s (3.6-4.3x,
-    # benchmarks/probe_25.py), and at 9x9/16x16 rungs never fire on any
-    # measured corpus (benchmarks/probe_rungs.py: remaining_after_first
-    # == 0 even at 22-clue hardness), so auto-fused risks nothing there.
+    # for GIANT geometries (n >= 16) where the kernel admits the rung
+    # shape, 'xla' everywhere else.  The round-4 rationale for
+    # composite-only rungs ("gang rungs live off steal reaction latency")
+    # was measured wrong where it matters: the fused gang rung took the
+    # deep-25x25 row 5.6 -> 20-24 boards/s (3.6-4.3x,
+    # benchmarks/probe_25.py).  The auto default is restricted to the
+    # geometry band that measurement covers (ADVICE r5): at 9x9-class
+    # boards rungs never fire on any measured corpus
+    # (benchmarks/probe_rungs.py: remaining_after_first == 0 even at
+    # 22-clue hardness), so an auto-fused small-board rung would be an
+    # unmeasured code path pretending to be a tuned default — pass
+    # rung_step_impl='fused' explicitly to opt a small-board rung in.
+    # KNOWN SEMANTIC GAP of the fused rung engine: the fused drivers run
+    # exactly ONE steal pairing per k-step dispatch, ignoring the
+    # steal_rounds=4 fan-out the composite gang rungs use
+    # (pallas_step/pallas_cover `_fused_round`; SolverConfig.steal_rounds
+    # documents the same) — a lone rich lane therefore feeds thief gangs
+    # a factor fused_steps*steal_rounds slower per frontier round.  Sound
+    # (steal timing never affects verdicts), and the measured 25x25 rows
+    # won DESPITE it, but treat steal_rounds as inert whenever a rung
+    # runs fused.
     # A rung whose shape the kernel cannot serve falls back to composite.
     rung_step_impl: Optional[str] = None
 
@@ -438,8 +452,11 @@ def solve_bulk(
         want_fused = (
             config.rung_step_impl == "fused"
             or (
+                # Auto-fused only where the fused gang rung was measured
+                # (giant boards; see BulkConfig.rung_step_impl).
                 config.rung_step_impl is None
                 and jax.default_backend() == "tpu"
+                and n >= 16
             )
         )
         if want_fused and mesh is None:

@@ -120,7 +120,19 @@ class SolverConfig:
     steal_rounds: int = 1  # pairings per step; >1 ramps idle gangs up faster
     #   (a donor serves one thief per round, so a lone rich lane feeds at
     #   most `steal_rounds` thieves per step — matters for wide-lane few-job
-    #   gang search, where 1 round means linear rather than quick fan-out)
+    #   gang search, where 1 round means linear rather than quick fan-out).
+    #   NOTE: the fused drivers (pallas_step/pallas_cover `_fused_round`)
+    #   run ONE pairing per k-step dispatch regardless of this knob — see
+    #   ops/bulk.BulkConfig.rung_step_impl for the serving consequence.
+    steal_gang: int = 0  # > 0: steal pairs only within consecutive lane
+    #   gangs of this size (lane l may steal from lanes in the same
+    #   floor(l / steal_gang) block).  The resident-flight invariant
+    #   (serving/scheduler.py): gang g's lanes only ever hold work for the
+    #   job seeded at lane g * steal_gang, so detaching that job leaves the
+    #   whole gang free for the next attach — global stealing would leak
+    #   other jobs' subtrees into the gang and make slot recycling unsound.
+    #   0 = global pairing (every batch-solve surface).  Must divide the
+    #   lane count when set.
     ring_steal_k: int = 8  # max boards shipped per step per chip pair (sharded)
 
     def __post_init__(self) -> None:
@@ -138,6 +150,8 @@ class SolverConfig:
             raise ValueError(
                 f"fused_sweep_unroll must be >= 0, got {self.fused_sweep_unroll}"
             )
+        if self.steal_gang < 0:
+            raise ValueError(f"steal_gang must be >= 0, got {self.steal_gang}")
 
     def with_fused_steps(self, surface_default: int) -> "SolverConfig":
         """Resolve ``fused_steps=None`` to the calling surface's default.
@@ -390,6 +404,76 @@ def purge_jobs(state: Frontier, dead: jax.Array) -> Frontier:
     )
 
 
+def attach_roots(
+    state: Frontier, roots: jax.Array, slot_ids: jax.Array, gang: int = 1
+) -> Frontier:
+    """Seed up to K newly admitted jobs into a *live* frontier — the attach
+    half of the resident flight (``serving/scheduler.py``), jit-stable.
+
+    ``roots`` uint32[K, h, w] (one root state per arriving job), ``slot_ids``
+    int32[K] (the job slot each root occupies; -1 = padding row, ignored).
+    K is a static shape, validity is dynamic, so one compiled program serves
+    every admission batch.  Root k lands on its slot's *home lane*
+    ``slot_ids[k] * gang``: under gang-scoped stealing
+    (``SolverConfig.steal_gang == gang``) every lane of gang g only ever
+    holds work for the job attached at slot g, so a slot handed out by the
+    host-side allocator is guaranteed a clean, free gang.  The slot's
+    bookkeeping rows are reset here (the previous tenant's verdict was
+    collected before the slot re-entered the free pool), so a stale
+    ``solved`` can never purge the new tenant.
+
+    Scatters are same-index across several leaves; the known XLA:TPU
+    variadic-scatter emitter crash (:func:`_seed_inverse`) starts at
+    ~131k lanes — far above serving-scale resident frontiers.
+    """
+    n_lanes = state.has_top.shape[0]
+    n_jobs = state.solved.shape[0]
+    ok = slot_ids >= 0
+    lane = jnp.where(ok, slot_ids * gang, n_lanes)  # OOB -> dropped
+    slot_t = jnp.where(ok, slot_ids, n_jobs)
+    zero_k = jnp.zeros(slot_ids.shape[0], jnp.int32)
+    return state._replace(
+        top=state.top.at[lane].set(roots.astype(jnp.uint32), mode="drop"),
+        has_top=state.has_top.at[lane].set(ok, mode="drop"),
+        job=state.job.at[lane].set(slot_ids, mode="drop"),
+        base=state.base.at[lane].set(zero_k, mode="drop"),
+        count=state.count.at[lane].set(zero_k, mode="drop"),
+        solved=state.solved.at[slot_t].set(False, mode="drop"),
+        solution=state.solution.at[slot_t].set(jnp.uint32(0), mode="drop"),
+        overflowed=state.overflowed.at[slot_t].set(False, mode="drop"),
+        nodes=state.nodes.at[slot_t].set(zero_k, mode="drop"),
+        sol_count=state.sol_count.at[slot_t].set(zero_k, mode="drop"),
+    )
+
+
+def detach(state: Frontier, slot_mask: jax.Array) -> Frontier:
+    """Free every lane and bookkeeping row of the jobs in ``slot_mask``
+    (bool[J]) — the release half of the resident flight's slot recycling.
+
+    Unlike :func:`purge_jobs` (a mid-flight CANCEL, which must keep the
+    job's verdict honest by downgrading it to unknown), detach runs *after*
+    the host collected the slot's verdict: lanes are cleared, the lane
+    ``job`` tag drops to -1, and the slot rows reset to their init state so
+    the next :func:`attach_roots` tenant starts clean.
+    """
+    n_jobs = state.solved.shape[0]
+    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
+    lane_dead = (state.job >= 0) & slot_mask[job_safe]
+    keep = ~slot_mask
+    return state._replace(
+        has_top=state.has_top & ~lane_dead,
+        count=jnp.where(lane_dead, 0, state.count),
+        job=jnp.where(lane_dead, jnp.int32(-1), state.job),
+        solved=state.solved & keep,
+        solution=jnp.where(
+            slot_mask[:, None, None], jnp.uint32(0), state.solution
+        ),
+        overflowed=state.overflowed & keep,
+        nodes=jnp.where(slot_mask, 0, state.nodes),
+        sol_count=jnp.where(slot_mask, 0, state.sol_count),
+    )
+
+
 def shed_rows(state: Frontier, job_id: jax.Array, k: int):
     """Extract up to ``k`` bottom stack rows of ``job_id`` for off-device work.
 
@@ -444,6 +528,49 @@ def _lane_by_rank(mask: jax.Array, n_lanes: int) -> jax.Array:
     )
 
 
+def pair_thieves_donors(
+    idle: jax.Array, donor: jax.Array, n_lanes: int, gang: int = 0
+):
+    """Rank-match idle lanes with donor lanes; the pairing core of every
+    steal variant (composite, boards-last fused, gang-scoped resident).
+
+    Returns ``(thief_lane, donor_lane, pair, n_pairs)`` on the rank axis
+    (int32[L], int32[L], bool[L], int32 scalar): entry r pairs the r-th
+    idle lane with the r-th donor lane; unmatched ranks carry ``n_lanes``
+    (an OOB sentinel scatters with ``mode='drop'``).  With ``gang > 0``
+    ranks are computed *within* each consecutive ``gang``-lane block
+    (reshape + per-row cumsum — still O(L), no sorting), so work never
+    crosses a gang boundary — the resident-flight slot invariant
+    (``SolverConfig.steal_gang``).
+    """
+    if gang > 0:
+        if n_lanes % gang:
+            raise ValueError(f"steal_gang={gang} does not divide lanes={n_lanes}")
+        n_gangs = n_lanes // gang
+        idle2 = idle.reshape(n_gangs, gang)
+        donor2 = donor.reshape(n_gangs, gang)
+        thief_of = jax.vmap(lambda m: _lane_by_rank(m, gang))(idle2)
+        donor_of = jax.vmap(lambda m: _lane_by_rank(m, gang))(donor2)
+        pairs_g = jnp.minimum(
+            jnp.sum(idle2, axis=1), jnp.sum(donor2, axis=1)
+        ).astype(jnp.int32)  # [G]
+        rank_in_gang = jnp.arange(gang, dtype=jnp.int32)[None, :]
+        pair2 = rank_in_gang < pairs_g[:, None]
+        offs = (jnp.arange(n_gangs, dtype=jnp.int32) * gang)[:, None]
+        # Within-gang lane -> global lane; unmatched ranks -> n_lanes.
+        thief_lane = jnp.where(pair2, thief_of + offs, n_lanes).reshape(-1)
+        donor_lane = jnp.where(pair2, donor_of + offs, n_lanes).reshape(-1)
+        return thief_lane, donor_lane, pair2.reshape(-1), jnp.sum(pairs_g)
+    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
+    n_pairs = jnp.minimum(jnp.sum(idle), jnp.sum(donor)).astype(jnp.int32)
+    thief_of = _lane_by_rank(idle, n_lanes)  # rank -> thief lane
+    donor_of = _lane_by_rank(donor, n_lanes)  # rank -> donor lane
+    pair = lane_idx < n_pairs  # rank axis
+    thief_lane = jnp.where(pair, thief_of, n_lanes)  # OOB -> dropped
+    donor_lane = jnp.where(pair, donor_of, n_lanes)
+    return thief_lane, donor_lane, pair, n_pairs
+
+
 def _steal(
     top: jax.Array,
     has_top: jax.Array,
@@ -452,28 +579,25 @@ def _steal(
     count: jax.Array,
     job: jax.Array,
     job_live: jax.Array,
+    gang: int = 0,
 ):
     """Match idle lanes with working lanes; hand each thief a donor's *bottom* row.
 
     Receiver-initiated like the reference's NEEDWORK (``/root/reference/
     DHT_Node.py:246-254``).  Pairing is k-th idle lane with k-th donor lane
     (both in lane order) via prefix-sum ranks — O(L) scatters, no sorting;
-    each donor serves at most one thief per round.  The stolen row goes
-    straight into the thief's ``top``, and the donor's bottom pointer bumps:
-    no stack data moves on the donor side at all.
+    each donor serves at most one thief per round (``gang`` scopes the
+    pairing to lane blocks, see :func:`pair_thieves_donors`).  The stolen
+    row goes straight into the thief's ``top``, and the donor's bottom
+    pointer bumps: no stack data moves on the donor side at all.
     """
     n_lanes, s = stack.shape[:2]
-    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
 
     idle = ~has_top
     donor = has_top & (count >= 1) & job_live
-    n_pairs = jnp.minimum(jnp.sum(idle), jnp.sum(donor)).astype(jnp.int32)
-
-    thief_of = _lane_by_rank(idle, n_lanes)  # rank -> thief lane
-    donor_of = _lane_by_rank(donor, n_lanes)  # rank -> donor lane
-    pair = lane_idx < n_pairs  # rank axis
-    thief_lane = jnp.where(pair, thief_of, n_lanes)  # OOB -> dropped
-    donor_lane = jnp.where(pair, donor_of, n_lanes)
+    thief_lane, donor_lane, pair, n_pairs = pair_thieves_donors(
+        idle, donor, n_lanes, gang
+    )
     safe_donor = jnp.clip(donor_lane, 0, n_lanes - 1)
 
     stolen = stack[safe_donor, base[safe_donor] % s]
@@ -599,7 +723,8 @@ def frontier_step(
     if config.steal:
         for _ in range(max(1, config.steal_rounds)):
             top, has_top, base, count, job_arr, k = _steal(
-                top, has_top, stack, base, count, job_arr, job_live
+                top, has_top, stack, base, count, job_arr, job_live,
+                gang=config.steal_gang,
             )
             job_live = (job_arr >= 0) & ~solved[jnp.clip(job_arr, 0, n_jobs - 1)]
             n_steals = n_steals + k

@@ -48,7 +48,7 @@ kernel"); the reference's one kernel (``/root/reference/DHT_Node.py:
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +58,7 @@ from jax.experimental import pallas as pl
 from distributed_sudoku_solver_tpu.models.cover import ExactCoverCSP, _unpack_bits
 from distributed_sudoku_solver_tpu.ops.pallas_propagate import (
     _VMEM,
+    VMEM_LIMIT_BYTES,
     _interpret_default,
     _vmem_params,
 )
@@ -122,6 +123,27 @@ def cover_consts(problem: ExactCoverCSP) -> CoverConsts:
         raise ValueError(
             "fused cover kernel needs the full incidence matrix; rebuild the "
             "instance via models.cover.build_cover (older pickles lack it)"
+        )
+    # Sentinel-soundness admission (ADVICE r5): every argmin key in the
+    # kernel must stay strictly below the _BIG sentinel AND inside f32-exact
+    # integer range (the keys flow through HIGHEST-precision f32 matmuls,
+    # exact only < 2^24).  The branch key is cnt * n_primary + column index
+    # (cnt <= n_rows, column index < n_cols_full-padded) and row keys run to
+    # the padded row count; past either bound a real key collides with the
+    # sentinel and argmin silently picks a wrong branch/row — corrupt
+    # SEARCH RESULTS, not a crash, so oversized instances must fail loudly
+    # here instead.
+    bw_adm = cover_block_words(problem)
+    r_pad_adm = -(-problem.w_rows // bw_adm) * bw_adm * 32
+    key_ceiling = problem.n_rows * problem.n_primary + problem.n_cols_full
+    if key_ceiling >= _BIG or r_pad_adm >= _BIG:
+        raise ValueError(
+            f"fused cover kernel cannot serve {problem.name!r}: argmin key "
+            f"range (rows {problem.n_rows} x primary {problem.n_primary} + "
+            f"cols {problem.n_cols_full} = {key_ceiling}, padded rows "
+            f"{r_pad_adm}) reaches the f32-exact sentinel bound {_BIG}; "
+            "use the composite engine (step_impl='xla') for instances this "
+            "large"
         )
     inc = _unpack_bits(
         problem.incidence, problem.n_cols_full
@@ -670,9 +692,64 @@ def advance_cover_fused(state, step_limit: jax.Array, problem, config):
     return fused_to_frontier(fs)
 
 
-def cover_fused_lanes(n_lanes: int) -> int:
+# Scoped-VMEM ceiling the kernels compile against — the same constant
+# _vmem_params hands Mosaic (imported at the top), so the admission check
+# and the compiler limit can never disagree.
+_VMEM_CEILING_BYTES = VMEM_LIMIT_BYTES
+
+
+def cover_vmem_bytes(problem: ExactCoverCSP, stack_slots: int, tile: int = 128) -> int:
+    """Lower-bound estimate of one lane tile's scoped-VMEM working set.
+
+    Counts what provably must be resident: the constant matrices
+    (incidence + pack/unpack selectors), the in/out state blocks (top,
+    stack, solution, meta), and the per-block streaming temporaries the
+    kernel body keeps live (~10 [BR, T] int32 tensors plus the column-space
+    tensors).  Deliberately a LOWER bound — Mosaic's own temporaries only
+    add to it — so exceeding the ceiling here is a proof of non-compilation,
+    never a false rejection of a shape the kernel could serve."""
+    bw = cover_block_words(problem)
+    br = bw * 32
+    r_pad = -(-problem.w_rows // bw) * bw * 32
+    # Full UNPACKED column count: cover_consts unpacks the bit-packed
+    # incidence to [R', n_cols_full] f32 — problem.incidence.shape[1] is
+    # the packed word count, 32x smaller, and would gut the estimate.
+    c_full = max(problem.n_cols_full, problem.n_primary)
+    c_pad = problem.w_cols * 32
+    d = problem.w_rows + problem.w_cols
+    t = min(tile, 128)
+    consts = (
+        r_pad * c_full  # inc_full
+        + 3 * br * bw  # sel_b / wlo_b / whi_b
+        + 3 * c_pad * problem.w_cols  # sel_c / wlo_c / whi_c
+    )
+    state = t * (2 * stack_slots * d + 3 * d + 2 * 16)  # stack io + top/sol + meta
+    working = t * (10 * br + 3 * c_pad + 2 * c_full)
+    return 4 * (consts + state + working)
+
+
+def cover_fused_lanes(
+    n_lanes: int,
+    problem: Optional[ExactCoverCSP] = None,
+    stack_slots: Optional[int] = None,
+) -> int:
     """Round a cover lane count to a fused-kernel-valid width (128-multiples
-    beyond one whole-array tile, the Mosaic lane-tiling rule)."""
+    beyond one whole-array tile, the Mosaic lane-tiling rule).
+
+    With ``problem`` + ``stack_slots`` this is also the launch-time
+    admission check mirroring ``pallas_step.fused_lanes`` (ADVICE r5): a
+    (instance, stack) shape whose tile working set provably overflows the
+    scoped-VMEM ceiling raises an actionable ``ValueError`` HERE instead of
+    an opaque Mosaic scoped-VMEM failure at first dispatch."""
+    if problem is not None and stack_slots is not None:
+        est = cover_vmem_bytes(problem, stack_slots)
+        if est > _VMEM_CEILING_BYTES:
+            raise ValueError(
+                f"fused cover kernel tile for {problem.name!r} needs >= "
+                f"{est >> 20} MB scoped VMEM at stack_slots={stack_slots} "
+                f"(ceiling {_VMEM_CEILING_BYTES >> 20} MB); use "
+                "step_impl='xla' or a shallower stack"
+            )
     if n_lanes <= 128:
         return n_lanes
     return -(-n_lanes // 128) * 128
@@ -702,7 +779,9 @@ def solve_cover_fused(states0: jax.Array, problem: ExactCoverCSP, config):
     # Cover keeps the shallow default everywhere (see advance_cover_fused).
     config = config.with_fused_steps(FUSED_STEPS_LINKED)
     n_jobs = states0.shape[0]
-    lanes = cover_fused_lanes(config.resolve_lanes(n_jobs))
+    lanes = cover_fused_lanes(
+        config.resolve_lanes(n_jobs), problem, config.stack_slots
+    )
     config = dataclasses.replace(config, lanes=lanes)
 
     state = init_frontier(states0, config)

@@ -387,6 +387,13 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# The scoped-VMEM ceiling every kernel compiles against (_vmem_params
+# passes it to Mosaic; pallas_cover's launch-time admission estimate
+# compares against the SAME constant, so retuning it cannot silently
+# desynchronize the admission check from the compiler limit).
+VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+
+
 def _vmem_params(interp: bool) -> dict:
     """``pallas_call`` kwargs raising the scoped-vmem ceiling on TPU.
 
@@ -402,7 +409,7 @@ def _vmem_params(interp: bool) -> dict:
 
     return {
         "compiler_params": pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024
+            vmem_limit_bytes=VMEM_LIMIT_BYTES
         )
     }
 

@@ -721,27 +721,24 @@ def fused_lanes(n_lanes: int, n: int, stack_slots: int) -> int:
     return -(-n_lanes // 128) * 128
 
 
-def _steal_t(top_t, has_top, stack_t, base, count, job, job_live):
+def _steal_t(top_t, has_top, stack_t, base, count, job, job_live, gang=0):
     """``ops.frontier._steal`` on boards-last tensors (lane axis last).
 
-    Same prefix-sum rank pairing; row movement is a slot gather
-    (``take_along_axis`` over S) + lane-axis gather/scatter.
+    Same prefix-sum rank pairing (``gang`` scopes it to lane blocks — the
+    resident flight's slot invariant, ``SolverConfig.steal_gang``); row
+    movement is a slot gather (``take_along_axis`` over S) + lane-axis
+    gather/scatter.
     """
-    from distributed_sudoku_solver_tpu.ops.frontier import _lane_by_rank
+    from distributed_sudoku_solver_tpu.ops.frontier import pair_thieves_donors
 
     n_lanes = has_top.shape[0]
     s = stack_t.shape[0]
-    lane_idx = jnp.arange(n_lanes, dtype=jnp.int32)
 
     idle = ~has_top
     donor = has_top & (count >= 1) & job_live
-    n_pairs = jnp.minimum(jnp.sum(idle), jnp.sum(donor)).astype(jnp.int32)
-
-    thief_of = _lane_by_rank(idle, n_lanes)
-    donor_of = _lane_by_rank(donor, n_lanes)
-    pair = lane_idx < n_pairs
-    thief_lane = jnp.where(pair, thief_of, n_lanes)
-    donor_lane = jnp.where(pair, donor_of, n_lanes)
+    thief_lane, donor_lane, pair, n_pairs = pair_thieves_donors(
+        idle, donor, n_lanes, gang
+    )
     safe_donor = jnp.clip(donor_lane, 0, n_lanes - 1)
 
     bottom = jnp.take_along_axis(
@@ -842,7 +839,8 @@ def _fused_round(
     n_steals = jnp.int32(0)
     if config.steal:
         top_t, has_top, base, count, job, n_steals = _steal_t(
-            top_t, has_top, stack_t, base, count, job, job_live
+            top_t, has_top, stack_t, base, count, job, job_live,
+            gang=getattr(config, "steal_gang", 0),
         )
 
     return FusedFrontier(

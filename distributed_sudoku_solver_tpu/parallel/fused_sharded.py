@@ -331,7 +331,10 @@ def _solve_cover_fused_sharded_jit(
 
     config = config.with_fused_steps(FUSED_STEPS_LINKED)
     per_chip = -(-config.resolve_lanes(n_jobs) // n_dev)
-    per_chip = cover_fused_lanes(per_chip)
+    # Launch-time VMEM/stack admission rides the width helper: an
+    # unservable (instance, stack) shape raises here, per chip, not as an
+    # opaque Mosaic compile failure at first dispatch.
+    per_chip = cover_fused_lanes(per_chip, problem, config.stack_slots)
     cfg = dataclasses.replace(config, lanes=per_chip * n_dev)
 
     state = init_frontier(states0, cfg)

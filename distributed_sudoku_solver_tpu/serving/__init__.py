@@ -9,3 +9,8 @@ from distributed_sudoku_solver_tpu.serving.portfolio import (  # noqa: F401
     PortfolioResult,
     race,
 )
+from distributed_sudoku_solver_tpu.serving.scheduler import (  # noqa: F401
+    EngineSaturated,
+    ResidentConfig,
+    ResidentFlight,
+)

@@ -81,6 +81,13 @@ class Job:
     shed_parts: int = 0
     exhausted: bool = False
     error: Optional[str] = None
+    # Absolute monotonic wall-clock budget, enforced at chunk granularity
+    # on both flight paths (resident scheduler AND static flights — a job
+    # that falls back from a saturated resident queue keeps its guarantee).
+    # None = no deadline on the static path, the default deadline on
+    # resident admission.  The legacy solve_fn path ignores it (one
+    # uninterruptible dispatch).
+    deadline: Optional[float] = None
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -143,6 +150,7 @@ class SolverEngine:
         chunk_steps: int = 64,
         max_flights: int = 4,
         handicap_s: float = 0.0,
+        resident=None,  # Optional[serving.scheduler.ResidentConfig]
     ):
         self.config = config
         self.max_batch = max_batch
@@ -174,6 +182,16 @@ class SolverEngine:
         self._queue: "queue.Queue[Job]" = queue.Queue()
         self._control: "queue.Queue[_Control]" = queue.Queue()
         self._flights: list[_Flight] = []  # owned by the device loop
+        # Continuous batching (serving/scheduler.py): one long-lived
+        # resident flight per geometry, admitting jobs between dispatches.
+        # Eligible submits route there; everything else (portfolio config
+        # overrides, roots resumes, count_all, fused-misfit geometries)
+        # keeps the static flight path.  Device work still happens only on
+        # the device loop; the dict itself is guarded by _lock.
+        self.resident_config = resident
+        self._resident: dict = {}  # Geometry -> ResidentFlight
+        self.resident_unfit = 0  # geometries the resident fused shape
+        #   cannot serve (fell back to static flights at submit time)
         # Insertion-ordered so stale entries (cancels for jobs that already
         # finished or never arrive) can be pruned oldest-first.
         self._cancelled: "dict[str, None]" = {}
@@ -222,7 +240,16 @@ class SolverEngine:
         geom: Optional[Geometry] = None,
         job_uuid: Optional[str] = None,
         config: Optional[SolverConfig] = None,
+        deadline_s: Optional[float] = None,
+        saturation: str = "fallback",
     ) -> Job:
+        """Enqueue one job.  Eligible jobs (no per-job config, no roots,
+        engine not enumerating) route into the geometry's resident flight
+        when one is configured (``serving/scheduler.py``); the rest take
+        the static flight path.  ``saturation`` picks the policy when the
+        resident admission queue is full: ``'fallback'`` (default) quietly
+        uses a static flight, ``'reject'`` raises ``EngineSaturated`` — the
+        HTTP layer's 429 + Retry-After backpressure."""
         g = np.asarray(grid, dtype=np.int32)
         geom = geom or geometry_for_size(g.shape[0])
         if g.shape != (geom.n, geom.n):
@@ -230,8 +257,73 @@ class SolverEngine:
         job = Job(
             uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom, config=config
         )
+        if deadline_s is not None:
+            job.deadline = job.submitted_at + deadline_s
+        if self._route_resident(job, saturation):
+            return job
         self._enqueue(job)
         return job
+
+    def _route_resident(self, job: Job, saturation: str) -> bool:
+        """True if the job was admitted to a resident flight."""
+        if (
+            self.resident_config is None
+            or not self._use_flights
+            or job.config is not None
+            or job.roots is not None
+            or self.config.count_all
+        ):
+            return False
+        rf = self._resident_for(job.geom)
+        if rf is None:
+            return False
+        if rf.try_admit(job):
+            return True
+        if saturation == "reject":
+            from distributed_sudoku_solver_tpu.serving.scheduler import (
+                EngineSaturated,
+            )
+
+            raise EngineSaturated(rf.retry_after_s())
+        return False  # fall back to a static flight
+
+    def _resident_for(self, geom: Geometry):
+        """The geometry's resident flight, created on first eligible submit
+        (host-side shape math only — device state appears lazily on the
+        device loop).  None = geometry unservable (fused misfit): the
+        caller falls back to static flights, which downgrade per-flight."""
+        with self._lock:
+            if self._stop.is_set():
+                return None
+            if geom in self._resident:
+                return self._resident[geom]
+            from distributed_sudoku_solver_tpu.serving.scheduler import (
+                ResidentFlight,
+            )
+
+            try:
+                rf = ResidentFlight(self, geom, self.resident_config)
+            except ValueError as e:
+                self.resident_unfit += 1
+                self._resident[geom] = None  # don't re-derive per submit
+                print(f"[engine] resident flight unfit for {geom}: {e}")
+                return None
+            self._resident[geom] = rf
+            return rf
+
+    def job_is_resident(self, job_uuid: str) -> bool:
+        """Whether a job is queued/running in a resident flight (resident
+        jobs have no snapshot/shed surface — the cluster's progress loop
+        skips them instead of polling a permanent None)."""
+        with self._lock:
+            flights = [rf for rf in self._resident.values() if rf is not None]
+        for rf in flights:
+            with rf._lock:
+                if any(j.uuid == job_uuid for j in rf._pending):
+                    return True
+            if any(j is not None and j.uuid == job_uuid for j in rf.slots):
+                return True
+        return False
 
     def _enqueue(self, job: Job) -> None:
         # Lock-ordered with stop()'s final drain: either this put happens
@@ -341,7 +433,13 @@ class SolverEngine:
         n = self._queue.qsize()
         for fl in list(self._flights):
             n += sum(0 if j.done.is_set() else 1 for j in fl.jobs)
+        for rf in self._resident_flights():
+            n += rf.queued_depth()
         return n
+
+    def _resident_flights(self) -> list:
+        with self._lock:
+            return [rf for rf in self._resident.values() if rf is not None]
 
     def stats(self) -> dict:
         return {
@@ -382,6 +480,17 @@ class SolverEngine:
             )
         out["active_flights"] = len(self._flights)
         out["fused_downgrades"] = int(self.fused_downgrades)
+        resident_flights = self._resident_flights()
+        if resident_flights:
+            # Slot occupancy, admission waits, and rejects per geometry —
+            # the continuous-batching observability (cluster nodes export
+            # this section verbatim through metrics_view).
+            out["resident"] = {
+                f"{rf.geom.n}x{rf.geom.n}": rf.metrics()
+                for rf in resident_flights
+            }
+        if self.resident_unfit:
+            out["resident_unfit"] = int(self.resident_unfit)
         if self._occ_chunks > 0:
             # Lane-occupancy inside fused dispatches: counts[k] = lanes
             # observed live for [10k, 10(k+1))% of the rounds their chunk
@@ -429,11 +538,12 @@ class SolverEngine:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            resident = [rf for rf in self._resident_flights() if rf.active()]
             # Admit new work (non-blocking while flights are active so a
             # running chunk never starves the queue check); the flight cap
             # bounds concurrent device frontiers — excess jobs wait queued.
             jobs = (
-                self._take_batch(wait=not self._flights)
+                self._take_batch(wait=not self._flights and not resident)
                 if len(self._flights) < self.max_flights
                 else []
             )
@@ -463,6 +573,19 @@ class SolverEngine:
                             job.done.set()
                     print(f"[engine] batch failed ({geom}): {e!r}")
             self._service_controls()
+            # Resident flights advance one chunk each, interleaved with the
+            # static flights below (same chunk-granularity fairness).
+            for rf in resident:
+                try:
+                    rf.step()
+                except Exception as e:  # noqa: BLE001
+                    # A resident device program died: fail its jobs, close
+                    # admission (future submits fall back to static
+                    # flights), keep the loop serving.
+                    rf.fail(e)
+                    with self._lock:
+                        self._resident[rf.geom] = None
+                    print(f"[engine] resident flight failed ({rf.geom}): {e!r}")
             # Round-robin: advance every active flight by one chunk.
             for fl in list(self._flights):
                 try:
@@ -492,6 +615,11 @@ class SolverEngine:
         for fl in self._flights:
             leftovers.extend(j for j in fl.jobs if not j.done.is_set())
         self._flights.clear()
+        # No _resident_flights() here: stop() calls this with _lock held
+        # (non-reentrant), and a raw dict-values read is safe under the GIL.
+        for rf in list(self._resident.values()):
+            if rf is not None:
+                rf.drain()
         for job in leftovers:
             if not job.done.is_set():
                 job.error = "engine stopped"
@@ -620,16 +748,32 @@ class SolverEngine:
 
         if self.handicap_s:
             time.sleep(self.handicap_s)
-        # Mid-flight cancellation: purge cancelled jobs' lanes in-graph.
+        # Mid-flight cancellation + deadline expiry: purge the jobs' lanes
+        # in-graph.  Deadlines are engine-wide wall-clock semantics (a job
+        # that falls back from a saturated resident flight keeps its
+        # guarantee here), enforced at chunk granularity like cancels.
+        now = time.monotonic()
         cancel_idx = self._peek_cancels(fl.jobs)
-        if cancel_idx:
+        expire_idx = [
+            i
+            for i, j in enumerate(fl.jobs)
+            if not j.done.is_set()
+            and i not in cancel_idx
+            and j.deadline is not None
+            and now > j.deadline
+        ]
+        if cancel_idx or expire_idx:
             dead = np.zeros(len(fl.state.solved), bool)
-            dead[cancel_idx] = True
+            dead[cancel_idx + expire_idx] = True
             fl.state = _purge(fl.state, jnp.asarray(dead))
             for i in cancel_idx:
                 job = fl.jobs[i]
                 if self._consume_cancel(job):
                     job.cancelled = True
+                self._finish_job(job)
+            for i in expire_idx:
+                job = fl.jobs[i]
+                job.error = "deadline expired"
                 self._finish_job(job)
         steps_before = int(fl.state.steps)
         lane_rounds_before = (

@@ -36,6 +36,11 @@ Differences are deliberate upgrades, not behavior drift:
 * the reference busy-polls a shared field at 10 ms and can cross-talk between
   concurrent requests (it nulls ``solution`` globally, ``:542,563``); here
   each request waits on its own job event.
+* **backpressure**: on an engine with resident flights enabled
+  (``serving/scheduler.py``), a ``POST /solve`` that arrives while the slot
+  pool and its bounded admission queue are both full is answered ``429``
+  with a ``Retry-After`` header (and ``retry_after_s`` in the body) instead
+  of queueing unboundedly — the reference would accept and stall forever.
 * unsat boards: the reference would search forever; we return 422 with a
   proven-unsat body (the frontier exhausts the space).
 * ``/stats`` aggregation uses the cluster runtime's snapshot instead of a
@@ -50,6 +55,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.scheduler import EngineSaturated
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -114,6 +120,21 @@ class _Handler(BaseHTTPRequestHandler):
                 job = node.submit(grid)
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
+            except EngineSaturated as e:
+                # Resident-flight admission control (serving/scheduler.py):
+                # slot pool and bounded queue are full, so the node sheds
+                # load loudly instead of queueing unboundedly.  Retry-After
+                # is the scheduler's backlog-paced estimate.
+                return self._send(
+                    429,
+                    {
+                        "error": "server saturated",
+                        "retry_after_s": round(e.retry_after_s, 3),
+                    },
+                    headers={
+                        "Retry-After": str(max(1, int(-(-e.retry_after_s // 1))))
+                    },
+                )
             if not job.wait(timeout):
                 node.cancel(job.uuid)
                 return self._send(504, {"error": "solve timed out", "uuid": job.uuid})
@@ -319,11 +340,13 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         return body
 
-    def _send(self, code: int, body: dict) -> None:
+    def _send(self, code: int, body: dict, headers: Optional[dict] = None) -> None:
         data = json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -384,7 +407,11 @@ class StandaloneNode:
         g = np.asarray(grid, dtype=np.int32)
         if g.ndim != 2 or g.shape[0] != g.shape[1]:
             raise ValueError(f"grid must be square, got {g.shape}")
-        return self.engine.submit(g)
+        # The serving node is where backpressure belongs: a saturated
+        # resident admission queue raises EngineSaturated here and the
+        # HTTP layer answers 429 + Retry-After.  Library callers using the
+        # engine directly keep the quiet static-flight fallback.
+        return self.engine.submit(g, saturation="reject")
 
     def cancel(self, job_uuid: str) -> None:
         self.engine.cancel(job_uuid)

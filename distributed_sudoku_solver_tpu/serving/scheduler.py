@@ -1,0 +1,479 @@
+"""Continuous-batching resident flights: an admission scheduler that packs
+live traffic into the long-lived fused (or composite) frontier.
+
+The static-flight engine (``serving/engine.py``) launches one frontier per
+admitted batch and tears it down when the batch drains: a request arriving
+one chunk after launch waits for a whole flight to retire before it gets a
+single lane, even while that flight's lanes go idle (the round-6
+``fused_lane_occupancy`` histogram shows exactly this endgame starvation).
+This module is the serving fix — the same shape as continuous batching in
+LLM inference serving, and the keep-the-device-saturated discipline the
+GPU-CP line of work argues for (PAPERS.md, "Experimenting with Constraint
+Programming on GPU"):
+
+* **One resident frontier per geometry**, shape-stable forever: fixed lane
+  count ``L = job_slots * gang_lanes`` and a fixed pool of ``job_slots``
+  job slots.  Every device program (init / attach / detach / poll /
+  advance) compiles once and is reused for the life of the process.
+* **Slot = gang of lanes.**  Slot ``j`` owns lanes ``[j*gang, (j+1)*gang)``
+  and work stealing is scoped to the gang (``SolverConfig.steal_gang``),
+  so a slot's lanes only ever hold its own job's subtrees — detaching the
+  job provably frees the whole gang for the next tenant.  (Global stealing
+  would leak other jobs' subtrees into the gang and make slot recycling
+  unsound: a stack row's job identity is its lane's ``job`` tag.)
+* **Admission between dispatches.**  Arriving jobs enter a bounded FIFO
+  queue; between fused dispatches the scheduler detaches finished slots,
+  recycles them, and attaches queued jobs in-graph
+  (``ops/frontier.attach_roots`` / ``detach`` — jit-stable: K is a static
+  shape, validity rides the data).  No teardown, no membership recompile.
+* **Backpressure, deadlines, cancellation.**  A full queue rejects with a
+  retry hint (the HTTP layer turns that into ``429`` + ``Retry-After``);
+  every admitted job carries a deadline (expired jobs are detached and
+  their slots recycled); a host ``cancel`` frees the slot in-graph at the
+  next chunk boundary, exactly like the static path's purge.
+
+Ownership: all device work happens on the engine's device-loop thread
+(``ResidentFlight.step`` is called between static-flight chunks); the
+admission queue is the only cross-thread surface.  Jobs ineligible for the
+resident flight — per-job config overrides (portfolio racers), roots
+resumes, ``count_all`` enumerations, fused-misfit geometries — keep using
+the static flight path unchanged (``SolverEngine._route_resident``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    attach_roots,
+    detach,
+    frontier_live,
+)
+
+# The resident frontier never retires, so the per-solve step budget is
+# replaced by wall-clock deadlines; int32 max keeps run_frontier's
+# steps-vs-max_steps guard permanently open (steps are rebased long before
+# they could reach it, see _REBASE_STEPS).
+_NO_STEP_BUDGET = (1 << 31) - 1
+# Rebase the monotonically growing step counter well before int32 overflow
+# (limits are relative: only steps-since-last-chunk matters).
+_REBASE_STEPS = 1 << 30
+
+
+class EngineSaturated(RuntimeError):
+    """Resident admission queue is full; retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"admission queue saturated; retry after {retry_after_s:.2f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidentConfig:
+    """Static shape of a resident flight (one per geometry)."""
+
+    job_slots: int = 16  # J_max: concurrent jobs resident on the device
+    gang_lanes: int = 8  # lanes per slot (per-job OR-parallel speculation
+    #   width; a slot's gang is its fair share — FIFO admission plus fixed
+    #   gangs is the fairness story, no job can starve another's lanes)
+    queue_depth: int = 64  # admission queue bound; beyond it submits are
+    #   rejected with a retry hint (HTTP: 429 + Retry-After) instead of
+    #   queueing unboundedly
+    attach_batch: int = 8  # max jobs attached per chunk boundary (the
+    #   static K of the jit-stable attach program)
+    chunk_steps: int = 64  # frontier rounds per resident dispatch — the
+    #   admission/cancel/deadline reaction latency, same knob as the
+    #   engine's static-flight chunk_steps
+    default_deadline_s: float = 300.0  # wall-clock budget per job (the
+    #   resident flight has no per-job step budget; deadlines bound it)
+
+    def __post_init__(self) -> None:
+        if self.job_slots < 1:
+            raise ValueError(f"job_slots must be >= 1, got {self.job_slots}")
+        if self.gang_lanes < 1:
+            raise ValueError(f"gang_lanes must be >= 1, got {self.gang_lanes}")
+        if self.queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {self.queue_depth}")
+        if self.attach_batch < 1:
+            raise ValueError(f"attach_batch must be >= 1, got {self.attach_batch}")
+
+
+# -- jitted device programs (module-level: caches shared across engines) ------
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "config", "n_slots"))
+def _init_resident(geom: Geometry, config: SolverConfig, n_slots: int) -> Frontier:
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier_roots
+
+    lanes = config.lanes
+    roots = jnp.zeros((lanes, geom.n, geom.n), jnp.uint32)
+    return init_frontier_roots(
+        roots, jnp.full(lanes, -1, jnp.int32), n_slots, config
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("geom", "gang"))
+def _attach_jit(
+    state: Frontier, grids: jax.Array, slot_ids: jax.Array, geom: Geometry, gang: int
+) -> Frontier:
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+
+    return attach_roots(state, encode_grid(grids, geom), slot_ids, gang)
+
+
+@jax.jit
+def _detach_jit(state: Frontier, slot_mask: jax.Array) -> Frontier:
+    return detach(state, slot_mask)
+
+
+@jax.jit
+def _poll_jit(state: Frontier):
+    """Per-slot verdict snapshot: one small fetch per chunk boundary."""
+    n_jobs = state.solved.shape[0]
+    live = frontier_live(state)
+    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
+    has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(live, mode="drop")
+    return state.solved, has_work, state.nodes, state.sol_count, state.overflowed
+
+
+def resident_solver_config(
+    base: SolverConfig, geom: Geometry, rcfg: ResidentConfig
+) -> SolverConfig:
+    """The shape-stable SolverConfig a resident flight runs: fixed lanes,
+    gang-scoped stealing, no step budget.
+
+    For a fused base config the lane count must be kernel-valid
+    (``pallas_step.fused_lanes``: whole-array <= 128 or a multiple of 128)
+    while staying an exact multiple of ``job_slots`` — the gang is bumped
+    to the smallest width satisfying both.  Raises ``ValueError`` when the
+    fused kernel cannot serve the geometry/stack at all (the caller falls
+    back to static flights — the resident path never downgrades silently).
+    """
+    gang = rcfg.gang_lanes
+    lanes = gang * rcfg.job_slots
+    if base.step_impl == "fused":
+        from distributed_sudoku_solver_tpu.ops.pallas_step import fused_lanes
+
+        if lanes > 128:
+            # Beyond one whole-array tile Mosaic requires 128-multiples;
+            # keep lanes = slots * gang exact by bumping the gang in steps
+            # of 128 / gcd(slots, 128).
+            step = 128 // math.gcd(rcfg.job_slots, 128)
+            gang = -(-gang // step) * step
+            lanes = gang * rcfg.job_slots
+        fused_lanes(lanes, geom.n, base.stack_slots)  # raises on misfit
+    return dataclasses.replace(
+        base,
+        lanes=lanes,
+        min_lanes=lanes,
+        steal_gang=gang,
+        max_steps=_NO_STEP_BUDGET,
+        count_all=False,
+    )
+
+
+class ResidentFlight:
+    """One long-lived frontier + its slot allocator and admission queue.
+
+    Thread contract: ``try_admit`` / ``retry_after_s`` / ``metrics`` may be
+    called from any thread; ``step`` / ``fail`` / ``drain`` only from the
+    engine's device-loop thread (single-owner device discipline).
+    """
+
+    def __init__(self, engine, geom: Geometry, rcfg: ResidentConfig):
+        self.engine = engine
+        self.geom = geom
+        self.rcfg = rcfg
+        self.config = resident_solver_config(engine.config, geom, rcfg)
+        self.gang = self.config.steal_gang
+        self.n_slots = rcfg.job_slots
+        self.state: Optional[Frontier] = None  # created lazily on the loop
+        self.slots: list = [None] * self.n_slots  # slot -> Job
+        self._free: deque = deque(range(self.n_slots))  # slot recycler
+        self._pending: deque = deque()  # FIFO admission queue
+        self._lock = threading.Lock()
+        self._closed = False
+        # Counters (occupancy/queue read under the lock; the rest are
+        # single-writer on the device loop, readers tolerate staleness).
+        self.admitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.expired = 0
+        self.chunks = 0
+        from distributed_sudoku_solver_tpu.utils.profiling import StatWindow
+
+        self.admission_wait = StatWindow()  # submit -> attach seconds
+        self.chunk_wall = StatWindow()
+
+    # -- any-thread surface --------------------------------------------------
+    def try_admit(self, job) -> bool:
+        """Queue ``job`` for attachment; False = saturated (or closed)."""
+        with self._lock:
+            if self._closed:
+                return False
+            if len(self._pending) >= self.rcfg.queue_depth:
+                self.rejected += 1
+                return False
+            if job.deadline is None:
+                job.deadline = time.monotonic() + self.rcfg.default_deadline_s
+            self._pending.append(job)
+            self.admitted += 1
+            return True
+
+    def retry_after_s(self) -> float:
+        """Backpressure hint: roughly how long until queue headroom opens —
+        the backlog ahead of a retry, paced at the recent per-job latency
+        over ``job_slots`` parallel servers."""
+        lat = self.engine.latency.snapshot()
+        per_job = lat["p50"] if lat else 0.5
+        with self._lock:
+            backlog = len(self._pending) + sum(
+                1 for s in self.slots if s is not None
+            )
+        return float(min(30.0, max(0.1, per_job * backlog / self.n_slots)))
+
+    def active(self) -> bool:
+        with self._lock:
+            return bool(self._pending) or any(
+                s is not None for s in self.slots
+            )
+
+    def queued_depth(self) -> int:
+        with self._lock:
+            return len(self._pending) + sum(
+                1 for s in self.slots if s is not None
+            )
+
+    def metrics(self) -> dict:
+        with self._lock:
+            occupied = sum(1 for s in self.slots if s is not None)
+            queued = len(self._pending)
+        out = {
+            "slots": self.n_slots,
+            "gang_lanes": self.gang,
+            "occupied": occupied,
+            "queued": queued,
+            "admitted": int(self.admitted),
+            "completed": int(self.completed),
+            "rejected": int(self.rejected),
+            "cancelled": int(self.cancelled),
+            "deadline_expired": int(self.expired),
+            "chunks": int(self.chunks),
+        }
+        aw = self.admission_wait.snapshot()
+        if aw:
+            out["admission_wait_ms"] = {
+                "count": aw["count"],
+                **{k: round(aw[k] * 1e3, 3) for k in ("p50", "p95", "p99")},
+            }
+        cw = self.chunk_wall.snapshot()
+        if cw:
+            out["chunk_wall_ms"] = {
+                "count": cw["count"],
+                **{k: round(cw[k] * 1e3, 3) for k in ("p50", "p95")},
+            }
+        return out
+
+    # -- device-loop surface -------------------------------------------------
+    def step(self) -> None:
+        """One scheduler round: sweep -> collect -> detach -> attach ->
+        advance."""
+        self._sweep_pending()
+        self._collect_and_detach()
+        self._attach_pending()
+        self._advance()
+
+    def _resolve_dead(self, job, cancelled: bool) -> None:
+        """Resolve a job that leaves the scheduler with no verdict: either
+        its cancel was consumed (``cancelled``) or its deadline passed.
+        The single definition of that bookkeeping — every exit path
+        (queue sweep, attach-time check, slot collection) goes through
+        here so flags, counters, and latency accounting cannot diverge."""
+        if cancelled:
+            job.cancelled = True
+            self.cancelled += 1
+        else:
+            job.error = "deadline expired"
+            self.expired += 1
+        self.engine._finish_job(job)
+
+    def _sweep_pending(self) -> None:
+        """Resolve cancelled/expired jobs still WAITING in the admission
+        queue, independently of slot availability.
+
+        Without this, dead queue entries would only drain when a slot
+        freed: with every slot busy on long jobs, a burst of timed-out
+        clients (HTTP 504 -> cancel) would keep the bounded queue full of
+        dead work — 429-ing live traffic for minutes — and the cancelled
+        jobs' done events would stay unset until a slot opened."""
+        now = time.monotonic()
+        with self._lock:
+            queued = list(self._pending)
+        dead = []
+        for job in queued:
+            cancelled = self.engine._consume_cancel(job)
+            expired = job.deadline is not None and now > job.deadline
+            if cancelled or expired:
+                dead.append((job, cancelled))
+        if not dead:
+            return
+        with self._lock:
+            for job, _ in dead:
+                self._pending.remove(job)  # single-threaded pop: present
+        for job, cancelled in dead:
+            self._resolve_dead(job, cancelled)
+
+    def _collect_and_detach(self) -> None:
+        """Resolve finished/cancelled/expired slot jobs; recycle their slots."""
+        if self.state is None or all(s is None for s in self.slots):
+            return
+        from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid
+
+        solved, has_work, nodes, sol_counts, overflowed = (
+            np.asarray(x) for x in _poll_jit(self.state)
+        )
+        now = time.monotonic()
+        detach_mask = np.zeros(self.n_slots, bool)
+        for slot, job in enumerate(self.slots):
+            if job is None:
+                continue
+            cancelled = self.engine._consume_cancel(job)
+            expired = job.deadline is not None and now > job.deadline
+            if not (solved[slot] or not has_work[slot] or cancelled or expired):
+                continue
+            detach_mask[slot] = True
+            if solved[slot]:
+                job.solved = True
+                job.solution = np.asarray(
+                    decode_grid(self.state.solution[slot]), np.int32
+                )
+                job.sol_count = int(sol_counts[slot])
+            elif not has_work[slot] and not cancelled:
+                # Space exhausted.  Resident jobs never shed, so exhaustion
+                # IS a proof — unless an overflow dropped a subtree, which
+                # downgrades the verdict to unknown exactly like the static
+                # path's finalize.  A complete proof beats a same-chunk
+                # deadline expiry: the client gets proven-unsat, not a
+                # spurious "deadline expired".
+                job.exhausted = not overflowed[slot]
+                job.unsat = job.exhausted
+            job.nodes = int(nodes[slot])
+            self.slots[slot] = None
+            with self._lock:
+                self._free.append(slot)
+            self.completed += 1
+            if cancelled or (
+                expired and not (job.solved or job.unsat or job.exhausted)
+            ):
+                # Leaving without a verdict (a found solution or a
+                # completed exhaustion proof always beats same-chunk
+                # expiry; a consumed cancel always marks the job).
+                self._resolve_dead(job, cancelled)
+            else:
+                self.engine._finish_job(job)
+        if detach_mask.any():
+            self.state = _detach_jit(self.state, jnp.asarray(detach_mask))
+
+    def _attach_pending(self) -> None:
+        """FIFO-drain the admission queue into free slots, one jit-stable
+        attach batch per chunk boundary."""
+        now = time.monotonic()
+        batch: list = []
+        while len(batch) < self.rcfg.attach_batch:
+            with self._lock:
+                if not self._pending or not self._free:
+                    break
+                job = self._pending.popleft()
+                slot = self._free.popleft()
+            # Queued-side cancel/expiry: resolve without ever touching the
+            # device; the slot goes straight back.
+            cancelled = self.engine._consume_cancel(job)
+            expired = job.deadline is not None and now > job.deadline
+            if cancelled or expired:
+                with self._lock:
+                    self._free.appendleft(slot)
+                self._resolve_dead(job, cancelled)
+                continue
+            # Record the slot BEFORE any device call: if the init/attach
+            # program below raises (compile/OOM), fail() -> drain() sweeps
+            # self.slots and resolves the job instead of leaving it
+            # stranded in a popped limbo with its done event never set.
+            self.slots[slot] = job
+            batch.append((slot, job))
+        if not batch:
+            return
+        if self.state is None:
+            self.state = _init_resident(self.geom, self.config, self.n_slots)
+        n = self.geom.n
+        k = self.rcfg.attach_batch
+        grids = np.zeros((k, n, n), np.int32)
+        slot_ids = np.full(k, -1, np.int32)
+        for i, (slot, job) in enumerate(batch):
+            grids[i] = job.grid
+            slot_ids[i] = slot
+            self.admission_wait.record(now - job.submitted_at)
+        self.state = _attach_jit(
+            self.state, jnp.asarray(grids), jnp.asarray(slot_ids),
+            self.geom, self.gang,
+        )
+
+    def _advance(self) -> None:
+        """One bounded-step chunk of the resident frontier."""
+        if self.state is None or all(s is None for s in self.slots):
+            return
+        if self.engine.handicap_s:
+            # The engine's slow-node simulator applies per resident chunk,
+            # exactly as it does per static-flight chunk.
+            time.sleep(self.engine.handicap_s)
+        if int(self.state.steps) > _REBASE_STEPS:
+            self.state = self.state._replace(steps=jnp.int32(0))
+        if self.config.step_impl == "fused":
+            from distributed_sudoku_solver_tpu.ops.pallas_step import (
+                advance_frontier_fused as _advance_fn,
+            )
+        else:
+            from distributed_sudoku_solver_tpu.utils.checkpoint import (
+                advance_frontier as _advance_fn,
+            )
+        limit = jnp.int32(int(self.state.steps) + self.rcfg.chunk_steps)
+        t0 = time.monotonic()
+        self.state = _advance_fn(self.state, limit, self.geom, self.config)
+        jax.block_until_ready(self.state)
+        self.chunk_wall.record(time.monotonic() - t0)
+        self.chunks += 1
+
+    def fail(self, exc: BaseException) -> None:
+        """A device program died (compile/OOM): fail every job this flight
+        holds and close admission — future submits fall back to static
+        flights, exactly like a failed static flight keeps the loop alive."""
+        self.drain(f"{type(exc).__name__}: {exc}")
+
+    def drain(self, reason: str = "engine stopped") -> None:
+        """Resolve everything still held at shutdown (nobody will ever
+        service these jobs; an un-set event would hang its waiter)."""
+        with self._lock:
+            self._closed = True
+            stranded = list(self._pending)
+            self._pending.clear()
+        stranded.extend(j for j in self.slots if j is not None)
+        self.slots = [None] * self.n_slots
+        for job in stranded:
+            if not job.done.is_set():
+                job.error = reason
+                job.done.set()
