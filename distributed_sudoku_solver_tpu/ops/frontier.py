@@ -756,6 +756,114 @@ def frontier_live(state: Frontier) -> jax.Array:
     return state.has_top & (state.job >= 0) & ~state.solved[job_safe]
 
 
+# -- packed chunk status -------------------------------------------------------
+#
+# The serving hot loops (engine static flights, resident scheduler, bulk
+# rungs) used to learn a chunk's outcome through a full-state
+# ``block_until_ready`` plus five-plus separate device->host value fetches —
+# each one a ~74-122 ms RPC through a tunneled device (BENCHMARKS.md
+# "Measured link"), and a host-stalls-device serialization even on attached
+# hosts.  Everything those fetches carried is tiny, so it is computed
+# IN-GRAPH at the end of each advance dispatch and packed into one small
+# int32 vector fetched once per chunk:
+#
+#   [0]               absolute ``steps`` (the authoritative counter — hosts
+#                     track deltas instead of fetching the scalar)
+#   [1]               sum over lanes of the chunk's ``lane_rounds`` delta
+#                     (mean lane-occupancy fraction = [1] / (L * steps_delta))
+#   [2:12]            10-bin decile histogram of per-lane live-rounds /
+#                     rounds-advanced for the chunk (the /metrics
+#                     ``fused_lane_occupancy`` data, previously a host-side
+#                     bincount over two full lane_rounds fetches)
+#   [12 : 12+w]       per-job ``solved`` bitmask, 32 jobs per word
+#   [12+w : 12+2w]    per-job has-work bitmask (any live lane owned by the
+#                     job); ``any_live`` of the whole frontier is "any bit
+#                     set" — the resident scheduler's poll and the static
+#                     loop's liveness check are the same word
+#
+# where ``w = ceil(n_jobs / 32)``.  ``status_len(n_jobs)`` is the vector
+# length; :func:`unpack_status` is the host-side (numpy) inverse.
+
+STATUS_STEPS = 0
+STATUS_LIVE_SUM = 1
+STATUS_HIST = 2  # .. STATUS_BITS: 10 decile bins
+STATUS_BITS = 12
+
+
+def status_len(n_jobs: int) -> int:
+    return STATUS_BITS + 2 * ((n_jobs + 31) // 32)
+
+
+def _pack_bits(bits: jax.Array) -> jax.Array:
+    """bool[J] -> int32[ceil(J/32)], bit b of word w = job 32*w + b."""
+    j = bits.shape[0]
+    w = (j + 31) // 32
+    padded = jnp.pad(bits, (0, w * 32 - j))
+    words = jnp.sum(
+        padded.reshape(w, 32).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    return jax.lax.bitcast_convert_type(words, jnp.int32)
+
+
+def chunk_status(
+    prev_steps: jax.Array, prev_lane_rounds: jax.Array, new: Frontier
+) -> jax.Array:
+    """int32[status_len(J)]: the packed per-chunk status word (see above).
+
+    ``prev_steps`` / ``prev_lane_rounds`` are the pre-advance values of the
+    same frontier, so the occupancy delta histogram needs no host-side
+    before/after bookkeeping — the advance program computes it from its own
+    input and output.
+    """
+    n_jobs = new.solved.shape[0]
+    live = frontier_live(new)
+    job_safe = jnp.clip(new.job, 0, n_jobs - 1)
+    has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(live, mode="drop")
+    delta = new.lane_rounds - prev_lane_rounds
+    # steps_delta == 0 (budget already exhausted): the guarded divisor keeps
+    # the bins well-defined; hosts ignore the histogram for empty chunks.
+    steps_delta = jnp.maximum(new.steps - prev_steps, 1)
+    bucket = jnp.clip((delta * 10) // steps_delta, 0, 9)
+    hist = jnp.zeros(10, jnp.int32).at[bucket].add(1)
+    return jnp.concatenate(
+        [
+            jnp.stack([new.steps, jnp.sum(delta, dtype=jnp.int32)]),
+            hist,
+            _pack_bits(new.solved),
+            _pack_bits(has_work),
+        ]
+    )
+
+
+def unpack_status(status, n_jobs: int) -> dict:
+    """Host-side inverse of :func:`chunk_status` (pure numpy, no device
+    work): ``{steps, live_sum, hist int64[10], solved bool[J],
+    has_work bool[J]}``."""
+    import numpy as np
+
+    status = np.asarray(status)
+    w = (n_jobs + 31) // 32
+
+    def bits(words):
+        # int64 sign-extension only touches bits >= 32; bits 0..31 survive.
+        return (
+            ((words.astype(np.int64)[:, None] >> np.arange(32)) & 1)
+            .astype(bool)
+            .reshape(-1)[:n_jobs]
+        )
+
+    return {
+        "steps": int(status[STATUS_STEPS]),
+        "live_sum": int(status[STATUS_LIVE_SUM]),
+        "hist": status[STATUS_HIST:STATUS_BITS].astype(np.int64),
+        "solved": bits(status[STATUS_BITS : STATUS_BITS + w]),
+        "has_work": bits(status[STATUS_BITS + w : STATUS_BITS + 2 * w]),
+    }
+
+
 def run_frontier(
     state: Frontier,
     problem: CSProblem,

@@ -891,7 +891,22 @@ def _run_fused(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("geom", "config"))
+def _advance_fused(state, step_limit, geom: Geometry, config):
+    """Shared body of the two public fused advance programs: resolve the
+    device-resident ``fused_steps`` default, clamp the limit to
+    ``max_steps``, and run the kernel rounds on the boards-last form.
+    One recipe, so the serving (status) and legacy twins cannot drift."""
+    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_DEVICE
+
+    config = config.with_fused_steps(FUSED_STEPS_DEVICE)
+    limit = jnp.minimum(jnp.int32(step_limit), jnp.int32(config.max_steps))
+    fs = frontier_to_fused(state)
+    return fused_to_frontier(_run_fused(fs, geom, config, limit))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("geom", "config"), donate_argnums=(0,)
+)
 def advance_frontier_fused(
     state, step_limit: jax.Array, geom: Geometry, config
 ):
@@ -915,13 +930,29 @@ def advance_frontier_fused(
     (``FUSED_STEPS_DEVICE`` — r4 re-sweep: 32 measured +16% device-only
     over 8; the reactivity cost only matters where chunks cross a link).
     """
-    from distributed_sudoku_solver_tpu.ops.frontier import FUSED_STEPS_DEVICE
+    return _advance_fused(state, step_limit, geom, config)
 
-    config = config.with_fused_steps(FUSED_STEPS_DEVICE)
-    limit = jnp.minimum(jnp.int32(step_limit), jnp.int32(config.max_steps))
-    fs = frontier_to_fused(state)
-    fs = _run_fused(fs, geom, config, limit)
-    return fused_to_frontier(fs)
+
+@functools.partial(
+    jax.jit, static_argnames=("geom", "config"), donate_argnums=(0,)
+)
+def advance_frontier_fused_status(state, steps_delta: jax.Array, geom: Geometry, config):
+    """Fused twin of ``utils.checkpoint.advance_frontier_status``: one
+    serving chunk — advance by at most ``steps_delta`` MORE rounds (the
+    limit is computed in-graph from the frontier's own ``steps``, so the
+    host can dispatch chunk k+1 without knowing chunk k's outcome) and
+    return ``(new_state, packed status word)``
+    (``ops/frontier.chunk_status``).  ``state`` is donated.  ``steps`` may
+    overshoot the limit by up to ``fused_steps - 1`` rounds exactly like
+    :func:`advance_frontier_fused`; the returned status carries the
+    authoritative value.
+    """
+    from distributed_sudoku_solver_tpu.ops.frontier import chunk_status
+
+    new = _advance_fused(
+        state, state.steps + jnp.int32(steps_delta), geom, config
+    )
+    return new, chunk_status(state.steps, state.lane_rounds, new)
 
 
 @functools.partial(jax.jit, static_argnames=("geom", "config"))

@@ -9,10 +9,16 @@ there is none of the reference's unlocked cross-thread mutation):
   on the job's event (no 10 ms busy-poll — a real `threading.Event`).
 * **the device loop** drains the queue into *flights*: a flight is one
   geometry-grouped batch of jobs sharing one frontier.  Each flight advances
-  in bounded-step chunks (``advance_frontier``), and multiple flights
-  round-robin — a hard batch no longer head-of-line-blocks later jobs, the
-  way the reference's single-threaded solve loop blocked its whole node
-  until the next message poll.
+  in bounded-step chunks (``advance_frontier_status`` /
+  ``advance_frontier_fused_status`` — buffer-donated, in-graph step limits),
+  and multiple flights round-robin — a hard batch no longer
+  head-of-line-blocks later jobs, the way the reference's single-threaded
+  solve loop blocked its whole node until the next message poll.  Since
+  round 8 the loop is **always one dispatch ahead**: chunk k+1 is enqueued
+  before chunk k's packed status word — the chunk's ONE host sync — is
+  consumed, so host scheduling overlaps device compute; cancels, deadlines,
+  and resolution consequently react one chunk late (bounded by
+  ``chunk_steps``, see ``_advance_flight``).
 * **cancel** lands *mid-flight*: between chunks the loop purges cancelled
   jobs' lanes in-graph (``ops/frontier.purge_jobs``), freeing the device
   within one chunk — the chunked heir of the reference's once-per-recursion
@@ -49,6 +55,33 @@ import numpy as np
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
 from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+
+def host_fetch(x, floor_s: float = 0.0, tag: str = "status"):
+    """THE device->host value seam of the serving hot loops.
+
+    Every value the engine's flight loop or the resident scheduler reads
+    off the device goes through here — which is what makes "exactly one
+    host sync per chunk" an enforceable contract instead of a comment: the
+    fetch-count guard test wraps this function and fails CI if a chunk
+    syncs more than once (a stray ``np.asarray`` in the hot loop used to
+    silently re-add ~100 ms/chunk through a tunneled device).
+
+    ``floor_s`` simulates the per-sync RPC floor of a tunneled device (the
+    engine's ``handicap_s`` slow-link simulator): the sleep happens HERE,
+    at the sync, because that is where a real tunnel pays it — and because
+    the loops dispatch ahead, the device computes straight through the
+    simulated floor exactly as it would through a real one.  ``tag``
+    classifies the sync for the guard: ``'status'`` (the one per-chunk
+    fetch), ``'event'`` (solve/detach verdict data, only on chunks where a
+    job resolved), ``'finalize'`` (terminal flight drain), ``'control'``
+    (rare snapshot/shed control requests — batched to one sync each, and
+    under the always-ahead loop they also wait out the in-flight chunk).
+    ``x`` may be a pytree; the result is the matching numpy tree.
+    """
+    if floor_s:
+        time.sleep(floor_s)
+    return jax.device_get(x)
 
 
 @dataclasses.dataclass
@@ -112,6 +145,13 @@ class _Flight:
     state: Frontier
     started: float = dataclasses.field(default_factory=time.monotonic)
     chunks: int = 0
+    # Always-ahead dispatch bookkeeping: the un-fetched packed status word
+    # of the most recently dispatched chunk (the device may still be
+    # computing it), and the host's view of the absolute step counter as of
+    # the last CONSUMED status — the authoritative value rides the status
+    # word, so the loop never fetches the ``steps`` scalar.
+    pending_status: Any = None
+    steps_seen: int = 0
 
 
 @dataclasses.dataclass
@@ -158,8 +198,12 @@ class SolverEngine:
         self.chunk_steps = max(1, chunk_steps)
         self.max_flights = max(1, max_flights)
         # Slow-node simulator (the reference's per-guess sleep, `-d`,
-        # ``DHT_Node.py:38,524``): flights sleep per *chunk*, the legacy
-        # path per batch.
+        # ``DHT_Node.py:38,524``): charged per HOST SYNC at the fetch seam
+        # (``host_fetch``) — one per flight chunk under the round-8
+        # one-fetch contract, so per-sync == per-chunk, but the device
+        # computes through the simulated floor exactly as it would through
+        # a real tunnel because the loops dispatch ahead.  The legacy
+        # solve_fn path sleeps per batch.
         self.handicap_s = handicap_s
         self._solve_fn = solve_fn or (
             lambda grids, geom, cfg: solve_batch(grids, geom, cfg)
@@ -169,11 +213,28 @@ class SolverEngine:
 
         self.latency = StatWindow()  # seconds per job
         self.batch_sizes = StatWindow()  # jobs per device batch
-        self.chunk_wall = StatWindow()  # seconds per flight-chunk advance
+        self.chunk_wall = StatWindow()  # seconds per flight-loop pass
+        #   (dispatch + sync) per chunk consumed
+        # The overlap split (round 8): dispatch wall is host time spent
+        # ENQUEUEING device work (async — near zero, and it must stay
+        # there), sync wall is host time blocked in the one per-chunk
+        # status fetch, which through a tunnel includes the RPC floor and
+        # on any backend includes waiting out device compute the host did
+        # not overlap.  sync >> dispatch is the pipelined loop working as
+        # designed; dispatch creeping up means something in the hot loop
+        # started blocking.
+        self.dispatch_wall = StatWindow()
+        self.sync_wall = StatWindow()
+        # Event/finalize fetch wall: the loop's only OTHER blocking reads
+        # — solved-job verdict data (blocks on the just-dispatched chunk's
+        # completion, so it can cost a chunk wall + floor) and terminal
+        # flight drains.  Rare by construction (resolution chunks only),
+        # but recorded so the dispatch/sync split never hides them.
+        self.event_wall = StatWindow()
         # Running totals for the device-step rate (single-writer: the device
-        # loop).  On an attached host chunk wall IS device wall; through a
-        # tunneled device it includes the per-dispatch RPC overhead — the
-        # /metrics field is named for what it measures, not a guess
+        # loop).  On an attached host sync wall bounds device step time;
+        # through a tunneled device it includes the per-sync RPC overhead —
+        # the /metrics field is named for what it measures, not a guess
         # (VERDICT r3 #8: bench.py derives the device-only number with a
         # measured RPC-floor subtraction, BENCHMARKS.md "Device-only
         # latency").
@@ -208,11 +269,15 @@ class SolverEngine:
         self.fused_downgrades = 0
         # Per-dispatch lane-occupancy histogram for fused flights (ROADMAP
         # 4b evidence): the kernel counts, per lane, how many in-kernel
-        # rounds it held live work (Frontier.lane_rounds); per chunk the
-        # loop buckets each lane's live-rounds / rounds-advanced fraction
-        # into 10 deciles.  Lanes stuck idle INSIDE a fused_steps dispatch
-        # — the starvation an in-kernel tile-local steal would fix — show
-        # up as mass in the low buckets.  Single-writer: the device loop.
+        # rounds it held live work (Frontier.lane_rounds); the advance
+        # program buckets each lane's live-rounds / rounds-advanced
+        # fraction into 10 deciles IN-GRAPH and ships the bins in the
+        # packed status word (round 8 — previously a host-side bincount
+        # over two full lane_rounds fetches per chunk, paid even when
+        # /metrics was never read).  Lanes stuck idle INSIDE a fused_steps
+        # dispatch — the starvation an in-kernel tile-local steal would
+        # fix — show up as mass in the low buckets.  Single-writer: the
+        # device loop.
         self._occ_hist = np.zeros(10, np.int64)
         self._occ_frac_sum = 0.0
         self._occ_chunks = 0
@@ -470,9 +535,25 @@ class SolverEngine:
                 "count": cw["count"],
                 **{k: round(cw[k] * 1e3, 3) for k in ("p50", "p95")},
             }
+        # The overlap split (round 8): dispatch wall = host time enqueueing
+        # device work (async, should stay near zero), sync wall = host time
+        # blocked in the one per-chunk status fetch.  Their gap is the
+        # observable proof that scheduling/admission work overlaps device
+        # compute instead of serializing with it (see __init__).
+        for name, win in (
+            ("dispatch_wall_ms", self.dispatch_wall),
+            ("sync_wall_ms", self.sync_wall),
+            ("event_wall_ms", self.event_wall),
+        ):
+            snap = win.snapshot()
+            if snap:
+                out[name] = {
+                    "count": snap["count"],
+                    **{k: round(snap[k] * 1e3, 3) for k in ("p50", "p95")},
+                }
         if self._chunk_steps_total > 0:
             # Per-frontier-round advance wall: device step time on attached
-            # hosts, device + per-dispatch RPC through a tunnel (see
+            # hosts, device + per-sync RPC through a tunnel (see
             # __init__).  The denominator counts frontier rounds actually
             # advanced, so compile-time outliers only dilute, never inflate.
             out["step_wall_ms_avg"] = round(
@@ -739,19 +820,34 @@ class SolverEngine:
         self._flights.append(_Flight(geom=geom, config=cfg, jobs=jobs, state=state))
 
     def _advance_flight(self, fl: _Flight) -> bool:
-        """One bounded-step chunk; returns True when the flight is done."""
-        import jax
+        """One pipelined flight-loop pass; returns True when the flight is done.
+
+        The always-ahead contract (round 8): every pass DISPATCHES chunk
+        k+1 (async — the in-graph step limit means the host needs nothing
+        from chunk k to do so) and then consumes chunk k's packed status
+        word in ONE host sync (``host_fetch``).  The device therefore
+        always has the next chunk enqueued while the host reads, reacts,
+        and schedules — host work overlaps device compute instead of
+        serializing with it.  The cost is a one-chunk reaction lag:
+        cancels, deadlines, solved-job resolution, and flight retirement
+        act on chunk k's status while chunk k+1 already runs (the same
+        granularity spirit as the chunk-boundary purge — bounded by
+        ``chunk_steps``, and the wasted trailing dispatch on a finished
+        frontier is an in-graph no-op because its while-loop condition is
+        already false).
+        """
         import jax.numpy as jnp
 
-        from distributed_sudoku_solver_tpu.ops.frontier import frontier_live
-        from distributed_sudoku_solver_tpu.utils.checkpoint import advance_frontier
+        from distributed_sudoku_solver_tpu.ops.frontier import unpack_status
 
-        if self.handicap_s:
-            time.sleep(self.handicap_s)
+        t_pass = time.monotonic()
         # Mid-flight cancellation + deadline expiry: purge the jobs' lanes
-        # in-graph.  Deadlines are engine-wide wall-clock semantics (a job
-        # that falls back from a saturated resident flight keeps its
-        # guarantee here), enforced at chunk granularity like cancels.
+        # in-graph (async dispatch — the purge rides the device queue ahead
+        # of the next chunk).  Deadlines are engine-wide wall-clock
+        # semantics (a job that falls back from a saturated resident flight
+        # keeps its guarantee here), enforced at chunk granularity like
+        # cancels; both need only host-side data, so they never wait on a
+        # status fetch.
         now = time.monotonic()
         cancel_idx = self._peek_cancels(fl.jobs)
         expire_idx = [
@@ -763,7 +859,9 @@ class SolverEngine:
             and now > j.deadline
         ]
         if cancel_idx or expire_idx:
-            dead = np.zeros(len(fl.state.solved), bool)
+            # The frontier's job dimension is the padded power-of-two
+            # bucket (see _start_flight), not len(fl.jobs).
+            dead = np.zeros(fl.state.solved.shape[0], bool)
             dead[cancel_idx + expire_idx] = True
             fl.state = _purge(fl.state, jnp.asarray(dead))
             for i in cancel_idx:
@@ -775,59 +873,82 @@ class SolverEngine:
                 job = fl.jobs[i]
                 job.error = "deadline expired"
                 self._finish_job(job)
-        steps_before = int(fl.state.steps)
-        lane_rounds_before = (
-            np.asarray(fl.state.lane_rounds)
-            if fl.config.step_impl == "fused"
-            else None
-        )
-        t_chunk = time.monotonic()
-        limit = jnp.int32(
-            min(steps_before + self.chunk_steps, fl.config.max_steps)
-        )
+        # Dispatch chunk k+1 BEFORE consuming chunk k's status.  Both
+        # advance programs donate the input frontier (zero state copies)
+        # and compute their step limit in-graph, so this call returns as
+        # soon as the work is enqueued.
         if fl.config.step_impl == "fused":
             # The whole-round VMEM kernel advances the same Frontier in
-            # fused_steps-quantized chunks; purge/cancel/shed above and the
+            # fused_steps-quantized chunks; purge/cancel/shed and the
             # finalize below are impl-agnostic (VERDICT r3 #1).
             from distributed_sudoku_solver_tpu.ops.pallas_step import (
-                advance_frontier_fused,
+                advance_frontier_fused_status as _advance,
+            )
+        else:
+            from distributed_sudoku_solver_tpu.utils.checkpoint import (
+                advance_frontier_status as _advance,
             )
 
-            fl.state = advance_frontier_fused(fl.state, limit, fl.geom, fl.config)
-        else:
-            fl.state = advance_frontier(fl.state, limit, fl.geom, fl.config)
-        jax.block_until_ready(fl.state)
+        fl.state, status_dev = _advance(
+            fl.state, jnp.int32(self.chunk_steps), fl.geom, fl.config
+        )
         fl.chunks += 1
-        solved = np.asarray(fl.state.solved)  # value fetch: the real sync
-        wall = time.monotonic() - t_chunk
+        prev_status = fl.pending_status
+        fl.pending_status = status_dev
+        self.dispatch_wall.record(time.monotonic() - t_pass)
+        if prev_status is None:
+            # Newborn flight: chunk 0 is in the device queue and the loop
+            # moves on — the flight is a full dispatch ahead from birth.
+            return False
+        # The chunk's single host sync.  The status word is sized by the
+        # frontier's padded job dimension (the bucket), not len(fl.jobs) —
+        # padding rows are never seeded, so their bits stay False.
+        t_sync = time.monotonic()
+        info = unpack_status(
+            host_fetch(prev_status, floor_s=self.handicap_s),
+            fl.state.solved.shape[0],
+        )
+        self.sync_wall.record(time.monotonic() - t_sync)
+        wall = time.monotonic() - t_pass
         self.chunk_wall.record(wall)
         self._chunk_wall_total += wall
-        steps_delta = int(fl.state.steps) - steps_before
+        steps_delta = info["steps"] - fl.steps_seen
+        fl.steps_seen = info["steps"]
         self._chunk_steps_total += steps_delta
-        if lane_rounds_before is not None and steps_delta > 0:
-            frac = (
-                np.asarray(fl.state.lane_rounds) - lane_rounds_before
-            ) / float(steps_delta)
-            self._occ_hist += np.bincount(
-                np.clip((frac * 10).astype(np.int64), 0, 9), minlength=10
-            )
-            self._occ_frac_sum += float(frac.mean())
+        if fl.config.step_impl == "fused" and steps_delta > 0:
+            # The in-graph occupancy histogram rides the status word — the
+            # old host-side bincount over two full lane_rounds fetches per
+            # chunk is gone (round 8 satellite).
+            self._occ_hist += info["hist"]
+            lanes = fl.state.has_top.shape[0]
+            self._occ_frac_sum += info["live_sum"] / float(lanes * steps_delta)
             self._occ_chunks += 1
-        any_live = bool(np.asarray(frontier_live(fl.state)).any())
-        out_of_budget = int(fl.state.steps) >= fl.config.max_steps
-        # Early per-job resolution: a solved job's waiter unblocks now, not
-        # when the whole flight drains.
-        if any_live and not out_of_budget:
-            for i, job in enumerate(fl.jobs):
-                if solved[i] and not job.done.is_set():
-                    self._resolve_from_state(fl, i, job)
+        out_of_budget = info["steps"] >= fl.config.max_steps
+        if info["has_work"].any() and not out_of_budget:
+            # Early per-job resolution: a solved job's waiter unblocks at
+            # the next status consumption, not when the whole flight
+            # drains.  Solved-job rows are frozen in-graph (the lanes are
+            # purged the round the job resolves), so reading them from the
+            # already-dispatched chunk k+1 state is exact.
+            solved = info["solved"]
+            newly = [
+                i
+                for i, job in enumerate(fl.jobs)
+                if solved[i] and not job.done.is_set()
+            ]
+            if newly:
+                self._resolve_solved(fl, newly)
             return False
         res = _finalize_jit(fl.state)
-        solutions = np.asarray(res.solution)
-        unsat = np.asarray(res.unsat)
-        nodes = np.asarray(res.nodes)
-        solved = np.asarray(res.solved)
-        sol_counts = np.asarray(res.sol_count)
+        fl.state = None
+        fl.pending_status = None
+        t_ev = time.monotonic()
+        solutions, unsat, nodes, solved, sol_counts = host_fetch(
+            (res.solution, res.unsat, res.nodes, res.solved, res.sol_count),
+            floor_s=self.handicap_s,
+            tag="finalize",
+        )
+        self.event_wall.record(time.monotonic() - t_ev)
         for i, job in enumerate(fl.jobs):
             if job.done.is_set():
                 continue
@@ -846,13 +967,41 @@ class SolverEngine:
         self.batch_sizes.record(float(len(fl.jobs)))
         return True
 
-    def _resolve_from_state(self, fl: _Flight, i: int, job: Job) -> None:
-        from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid
+    def _resolve_solved(self, fl: _Flight, idx: list) -> None:
+        """ONE batched event fetch for every job that solved this chunk —
+        ten jobs solving together must not pay ten serialized RPC floors
+        (the resident path's ``_verdict_jit`` is the same shape).
 
-        job.solved = True
-        job.solution = np.asarray(decode_grid(fl.state.solution[i]), np.int32)
-        job.nodes = int(np.asarray(fl.state.nodes[i]))
-        self._finish_job(job)
+        Two deliberate trade-offs, both bounded to resolution chunks:
+        ``fl.state`` here is the chunk dispatched THIS pass, so the fetch
+        waits out that chunk's device wall (solved rows are frozen
+        in-graph, so the values are exact; the device is busy on exactly
+        the awaited chunk, never idle) — recorded in ``event_wall`` so the
+        dispatch/sync split cannot hide it.  And the payload ships the
+        whole padded bucket's decoded grids rather than a gather of the
+        solved rows: one stable compiled shape, ~83 KB at a full 256-job
+        9x9 bucket (under one RPC floor through the tunnel); a static-K
+        in-graph gather is the upgrade path if giant-geometry buckets
+        ever serve interactively."""
+        t_ev = time.monotonic()
+        solutions, nodes = host_fetch(
+            _flight_verdict_jit(fl.state),
+            floor_s=self.handicap_s,
+            tag="event",
+        )
+        ev = time.monotonic() - t_ev
+        self.event_wall.record(ev)
+        # This fetch blocked out chunk k+1's device wall; without this the
+        # step_wall_ms_avg numerator misses exactly the chunks that
+        # resolved jobs (their steps still land in _chunk_steps_total at
+        # the next status consumption) and reads the device too fast.
+        self._chunk_wall_total += ev
+        for i in idx:
+            job = fl.jobs[i]
+            job.solved = True
+            job.solution = np.asarray(solutions[i], np.int32)
+            job.nodes = int(nodes[i])
+            self._finish_job(job)
 
     def _finish_job(self, job: Job) -> None:
         self.latency.record(time.monotonic() - job.submitted_at)
@@ -901,12 +1050,17 @@ class SolverEngine:
         fl, i = self._find_flight(job_uuid)
         if fl is None or fl.jobs[i].done.is_set():
             return None
-        rows = _rows_of_job_host(fl.state, i)
+        # One control sync for the whole frontier (a few MB at engine
+        # scale): under the always-ahead loop this blocks on the in-flight
+        # chunk too, so batch it and charge it at the seam rather than
+        # paying ~7 stray per-array syncs outside the contract.
+        st = host_fetch(fl.state, floor_s=self.handicap_s, tag="control")
+        rows = _rows_of_job_host(st, i)
         if rows.shape[0] == 0:
             return None
         return (
             rows,
-            int(np.asarray(fl.state.nodes[i])),
+            int(st.nodes[i]),
             fl.jobs[i].shed_parts,
             dataclasses.asdict(fl.config),
         )
@@ -915,7 +1069,9 @@ class SolverEngine:
         import jax.numpy as jnp
 
         # Neediest job: most deferred stack rows across lanes (host-side scan
-        # of the small [L] vectors); shedding is rare, one sync is fine.
+        # of the small [L] vectors); shedding is rare, one sync per flight
+        # is fine — but it goes through the seam (batched, tagged) because
+        # under the always-ahead loop it also waits out the in-flight chunk.
         best = None  # (stack_rows, flight, job index)
         for fl in self._flights:
             if fl.config.count_all:
@@ -923,9 +1079,11 @@ class SolverEngine:
                 # and aggregated nowhere — the returned model count would
                 # silently miss those subtrees.  Enumerations never shed.
                 continue
-            jobv = np.asarray(fl.state.job)
-            countv = np.asarray(fl.state.count)
-            solvedv = np.asarray(fl.state.solved)
+            jobv, countv, solvedv = host_fetch(
+                (fl.state.job, fl.state.count, fl.state.solved),
+                floor_s=self.handicap_s,
+                tag="control",
+            )
             for i, job in enumerate(fl.jobs):
                 if job.done.is_set() or solvedv[i]:
                     continue
@@ -937,7 +1095,10 @@ class SolverEngine:
         _, fl, i = best
         new_state, rows, valid = _shed_jit(fl.state, jnp.int32(i), k)
         fl.state = new_state
-        rows = np.asarray(rows)[np.asarray(valid)]
+        rows, valid = host_fetch(
+            (rows, valid), floor_s=self.handicap_s, tag="control"
+        )
+        rows = rows[valid]
         if rows.shape[0] == 0:
             return None
         fl.jobs[i].shed_parts += 1
@@ -1020,14 +1181,19 @@ def _start_packed(roots, valid, config: SolverConfig) -> Frontier:
     return init_frontier_packed(roots, valid, config)
 
 
-@jax.jit
+# Every frontier-threading program donates its input state (round 8): the
+# engine always rebinds (`fl.state = _purge(fl.state, ...)`), so the old
+# buffers alias the new ones instead of costing a full-frontier HBM copy
+# per dispatch.  Donation never changes values (pinned by the donated-vs-
+# undonated A/B tests), only buffer ownership.
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _purge(state: Frontier, dead) -> Frontier:
     from distributed_sudoku_solver_tpu.ops.frontier import purge_jobs
 
     return purge_jobs(state, dead)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k",), donate_argnums=(0,))
 def _shed_jit(state: Frontier, job_id, k: int):
     from distributed_sudoku_solver_tpu.ops.frontier import shed_rows
 
@@ -1035,7 +1201,18 @@ def _shed_jit(state: Frontier, job_id, k: int):
 
 
 @jax.jit
+def _flight_verdict_jit(state: Frontier):
+    """Resolution-chunk verdict payload (decoded grids + node counts) as
+    one compiled program — the static-flight twin of the scheduler's
+    ``_verdict_jit``.  NOT donated: the flight state lives on."""
+    from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid
+
+    return decode_grid(state.solution), state.nodes
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _finalize_jit(state: Frontier):
+    """Terminal drain — the caller drops the flight state right after."""
     from distributed_sudoku_solver_tpu.ops.solve import _finalize
 
     return _finalize(state)

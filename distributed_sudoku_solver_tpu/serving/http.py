@@ -11,6 +11,14 @@ DHT_Node.py:540-614`` (SudokuHandler):
 Superset endpoints (absent from the reference):
 
 * ``GET /metrics`` — latency percentiles, batch sizes, device info.
+  Since round 8 the flight-loop wall is split into ``dispatch_wall_ms``
+  (host time enqueueing device work — async, near zero),
+  ``sync_wall_ms`` (host time blocked in the one per-chunk status
+  fetch), and ``event_wall_ms`` (the rarer verdict/finalize fetches on
+  chunks where a job resolved), so the always-ahead loop's host/device
+  overlap is observable; the resident section's ``chunk_wall_ms`` is
+  likewise the per-round status sync wall, with its own
+  ``dispatch_wall_ms`` / ``event_wall_ms``.
 * ``POST /solve`` with ``"count_all": true`` — enumerate EVERY solution
   to exhaustion and return the exact model count plus the first solution
   found (the reference's DFS stops at one solution and cannot express
@@ -43,6 +51,12 @@ Differences are deliberate upgrades, not behavior drift:
   of queueing unboundedly — the reference would accept and stall forever.
 * unsat boards: the reference would search forever; we return 422 with a
   proven-unsat body (the frontier exhausts the space).
+* cancellation (a timed-out ``/solve`` cancels its job) and deadlines act
+  at chunk granularity, and since round 8 one chunk LATE: the engine's
+  always-ahead loop enqueues chunk k+1 before reading chunk k's status,
+  so a cancel frees the device within two chunk boundaries instead of
+  one — the price of never letting the host stall the device
+  (``serving/engine.py``).
 * ``/stats`` aggregation uses the cluster runtime's snapshot instead of a
   blind 1 s sleep window (``:571``).
 """

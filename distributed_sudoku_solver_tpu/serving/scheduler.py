@@ -26,6 +26,15 @@ Programming on GPU"):
   recycles them, and attaches queued jobs in-graph
   (``ops/frontier.attach_roots`` / ``detach`` — jit-stable: K is a static
   shape, validity rides the data).  No teardown, no membership recompile.
+* **One sync per chunk, one chunk behind (round 8).**  Each scheduler
+  round consumes the PREVIOUS advance's packed status word
+  (``ops/frontier.chunk_status``) in one host fetch, then detach / attach
+  / the next advance are async dispatches against donated buffers — the
+  old per-round ``_poll_jit`` five-array fetch, ``int(state.steps)``
+  scalar fetch, and full-state ``block_until_ready`` are gone.  Verdicts
+  and slot recycling therefore react one chunk late (sound: solved-slot
+  rows freeze in-graph, and a workless gang cannot regrow work), and the
+  host never stalls the device except for that single fetch.
 * **Backpressure, deadlines, cancellation.**  A full queue rejects with a
   retry hint (the HTTP layer turns that into ``429`` + ``Retry-After``);
   every admitted job carries a deadline (expired jobs are detached and
@@ -60,8 +69,9 @@ from distributed_sudoku_solver_tpu.ops.frontier import (
     SolverConfig,
     attach_roots,
     detach,
-    frontier_live,
+    unpack_status,
 )
+from distributed_sudoku_solver_tpu.serving import engine as engine_mod
 
 # The resident frontier never retires, so the per-solve step budget is
 # replaced by wall-clock deadlines; int32 max keeps run_frontier's
@@ -127,7 +137,13 @@ def _init_resident(geom: Geometry, config: SolverConfig, n_slots: int) -> Fronti
     )
 
 
-@functools.partial(jax.jit, static_argnames=("geom", "gang"))
+# The resident state is donated through every program that threads it
+# (attach / detach / advance): the scheduler always rebinds
+# ``self.state = ...``, so the long-lived frontier's buffers are reused
+# in place instead of copied per dispatch (round 8).
+@functools.partial(
+    jax.jit, static_argnames=("geom", "gang"), donate_argnums=(0,)
+)
 def _attach_jit(
     state: Frontier, grids: jax.Array, slot_ids: jax.Array, geom: Geometry, gang: int
 ) -> Frontier:
@@ -136,19 +152,30 @@ def _attach_jit(
     return attach_roots(state, encode_grid(grids, geom), slot_ids, gang)
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _detach_jit(state: Frontier, slot_mask: jax.Array) -> Frontier:
     return detach(state, slot_mask)
 
 
 @jax.jit
-def _poll_jit(state: Frontier):
-    """Per-slot verdict snapshot: one small fetch per chunk boundary."""
-    n_jobs = state.solved.shape[0]
-    live = frontier_live(state)
-    job_safe = jnp.clip(state.job, 0, n_jobs - 1)
-    has_work = jnp.zeros(n_jobs, bool).at[job_safe].max(live, mode="drop")
-    return state.solved, has_work, state.nodes, state.sol_count, state.overflowed
+def _verdict_jit(state: Frontier):
+    """Detach-time verdict payload, fetched ONLY on chunks where a slot
+    actually leaves (an event fetch, not a per-round poll): per-slot node
+    counts, model counts, overflow flags, and the decoded solution grids.
+    The per-round poll itself is gone — its solved / has-work bits ride
+    the packed status word the advance program returns
+    (``ops/frontier.chunk_status``).  Ships the whole slot pool's rows
+    (one stable compiled shape; ~83 KB at 256 9x9 slots — under one RPC
+    floor); a static-K gather of just the leaving slots is the upgrade
+    path for giant-geometry pools."""
+    from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid
+
+    return (
+        state.nodes,
+        state.sol_count,
+        state.overflowed,
+        decode_grid(state.solution),
+    )
 
 
 def resident_solver_config(
@@ -203,6 +230,14 @@ class ResidentFlight:
         self.gang = self.config.steal_gang
         self.n_slots = rcfg.job_slots
         self.state: Optional[Frontier] = None  # created lazily on the loop
+        # Pipelined status plumbing (round 8): the un-fetched packed status
+        # word of the most recent advance dispatch, and the host-side copy
+        # of the last consumed one.  The scheduler round consumes the
+        # previous chunk's status in ONE host sync, reacts, and dispatches
+        # the next chunk without ever blocking on device state.
+        self._pending_status = None
+        self._status: Optional[dict] = None
+        self._event_wall = 0.0  # last round's verdict-fetch sync wall
         self.slots: list = [None] * self.n_slots  # slot -> Job
         self._free: deque = deque(range(self.n_slots))  # slot recycler
         self._pending: deque = deque()  # FIFO admission queue
@@ -219,7 +254,18 @@ class ResidentFlight:
         from distributed_sudoku_solver_tpu.utils.profiling import StatWindow
 
         self.admission_wait = StatWindow()  # submit -> attach seconds
-        self.chunk_wall = StatWindow()
+        self.chunk_wall = StatWindow()  # per-chunk status-sync wall: time
+        #   blocked consuming the previous advance's packed status word
+        #   (includes the simulated per-sync floor; device compute the
+        #   host did not overlap shows up here and nowhere else)
+        self.dispatch_wall = StatWindow()  # host time per round spent
+        #   ENQUEUEING device work (collect/detach/attach/advance — all
+        #   async); the gap to chunk_wall is the observable overlap,
+        #   mirroring the engine's dispatch_wall_ms / sync_wall_ms split
+        self.event_wall = StatWindow()  # detach-round verdict fetches —
+        #   the round's SECOND sync (floor included), recorded so the
+        #   split never hides it (same property as the engine's
+        #   event_wall)
 
     # -- any-thread surface --------------------------------------------------
     def try_admit(self, job) -> bool:
@@ -282,22 +328,56 @@ class ResidentFlight:
                 "count": aw["count"],
                 **{k: round(aw[k] * 1e3, 3) for k in ("p50", "p95", "p99")},
             }
-        cw = self.chunk_wall.snapshot()
-        if cw:
-            out["chunk_wall_ms"] = {
-                "count": cw["count"],
-                **{k: round(cw[k] * 1e3, 3) for k in ("p50", "p95")},
-            }
+        for name, win in (
+            ("chunk_wall_ms", self.chunk_wall),  # per-round status sync
+            ("dispatch_wall_ms", self.dispatch_wall),  # async enqueue time
+            ("event_wall_ms", self.event_wall),  # detach-round verdicts
+        ):
+            snap = win.snapshot()
+            if snap:
+                out[name] = {
+                    "count": snap["count"],
+                    **{k: round(snap[k] * 1e3, 3) for k in ("p50", "p95")},
+                }
         return out
 
     # -- device-loop surface -------------------------------------------------
     def step(self) -> None:
-        """One scheduler round: sweep -> collect -> detach -> attach ->
-        advance."""
+        """One scheduler round: sweep -> consume status -> collect ->
+        detach -> attach -> advance.
+
+        The round's ONE host sync is the status consumption; detach,
+        attach, and the next advance are async dispatches, so the host
+        returns to the engine loop (other flights, controls, admission)
+        while the device crunches the chunk just enqueued.  Consequences
+        of a chunk are therefore observed one chunk late — the same
+        documented reaction lag as the static flight loop."""
         self._sweep_pending()
+        self._consume_status()
+        t0 = time.monotonic()
+        self._event_wall = 0.0
         self._collect_and_detach()
         self._attach_pending()
         self._advance()
+        if self._pending_status is not None:  # a chunk was dispatched
+            # Exclude the detach-round verdict fetch (a sync, recorded by
+            # _collect_and_detach) so dispatch_wall stays what it claims:
+            # async enqueue time.
+            self.dispatch_wall.record(time.monotonic() - t0 - self._event_wall)
+
+    def _consume_status(self) -> None:
+        """Fetch the previous advance's packed status word (the round's
+        single host sync); no-op when no advance is outstanding."""
+        if self._pending_status is None:
+            return
+        t0 = time.monotonic()
+        raw = engine_mod.host_fetch(
+            self._pending_status, floor_s=self.engine.handicap_s
+        )
+        self._pending_status = None
+        self._status = unpack_status(raw, self.n_slots)
+        self.chunk_wall.record(time.monotonic() - t0)
+        self.chunks += 1
 
     def _resolve_dead(self, job, cancelled: bool) -> None:
         """Resolve a job that leaves the scheduler with no verdict: either
@@ -340,16 +420,24 @@ class ResidentFlight:
             self._resolve_dead(job, cancelled)
 
     def _collect_and_detach(self) -> None:
-        """Resolve finished/cancelled/expired slot jobs; recycle their slots."""
-        if self.state is None or all(s is None for s in self.slots):
-            return
-        from distributed_sudoku_solver_tpu.ops.bitmask import decode_grid
+        """Resolve finished/cancelled/expired slot jobs; recycle their slots.
 
-        solved, has_work, nodes, sol_counts, overflowed = (
-            np.asarray(x) for x in _poll_jit(self.state)
-        )
+        Solved / has-work bits come from the last consumed status word —
+        one chunk stale by design, and sound: a solved slot's rows are
+        frozen in-graph the round it resolves, and a slot with no live
+        lanes cannot regrow work (stealing is gang-scoped), so the verdict
+        payload read from the already-dispatched next chunk's state is
+        exact.  The payload fetch (``_verdict_jit``) happens ONLY on
+        rounds where a slot actually leaves."""
+        if self.state is None or self._status is None or all(
+            s is None for s in self.slots
+        ):
+            return
+        solved = self._status["solved"]
+        has_work = self._status["has_work"]
         now = time.monotonic()
         detach_mask = np.zeros(self.n_slots, bool)
+        leaving: list = []  # (slot, job, cancelled, expired)
         for slot, job in enumerate(self.slots):
             if job is None:
                 continue
@@ -358,11 +446,31 @@ class ResidentFlight:
             if not (solved[slot] or not has_work[slot] or cancelled or expired):
                 continue
             detach_mask[slot] = True
+            leaving.append((slot, job, cancelled, expired))
+        if not leaving:
+            return
+        # The event fetch: one sync for every leaving slot's verdict data —
+        # skipped entirely when every leaver departs verdict-less (cancelled
+        # or expired mid-search), since none of the payload would be read;
+        # those jobs keep nodes=0 (best-effort) instead of paying an RPC
+        # floor plus the in-flight chunk's wall for a discarded fetch.
+        nodes = sol_counts = overflowed = solutions = None
+        if any(
+            solved[slot] or (not has_work[slot] and not cancelled)
+            for slot, job, cancelled, expired in leaving
+        ):
+            t_ev = time.monotonic()
+            nodes, sol_counts, overflowed, solutions = engine_mod.host_fetch(
+                _verdict_jit(self.state),
+                floor_s=self.engine.handicap_s,
+                tag="event",
+            )
+            self._event_wall = time.monotonic() - t_ev
+            self.event_wall.record(self._event_wall)
+        for slot, job, cancelled, expired in leaving:
             if solved[slot]:
                 job.solved = True
-                job.solution = np.asarray(
-                    decode_grid(self.state.solution[slot]), np.int32
-                )
+                job.solution = np.asarray(solutions[slot], np.int32)
                 job.sol_count = int(sol_counts[slot])
             elif not has_work[slot] and not cancelled:
                 # Space exhausted.  Resident jobs never shed, so exhaustion
@@ -373,7 +481,8 @@ class ResidentFlight:
                 # spurious "deadline expired".
                 job.exhausted = not overflowed[slot]
                 job.unsat = job.exhausted
-            job.nodes = int(nodes[slot])
+            if nodes is not None:
+                job.nodes = int(nodes[slot])
             self.slots[slot] = None
             with self._lock:
                 self._free.append(slot)
@@ -387,8 +496,7 @@ class ResidentFlight:
                 self._resolve_dead(job, cancelled)
             else:
                 self.engine._finish_job(job)
-        if detach_mask.any():
-            self.state = _detach_jit(self.state, jnp.asarray(detach_mask))
+        self.state = _detach_jit(self.state, jnp.asarray(detach_mask))
 
     def _attach_pending(self) -> None:
         """FIFO-drain the admission queue into free slots, one jit-stable
@@ -434,29 +542,36 @@ class ResidentFlight:
         )
 
     def _advance(self) -> None:
-        """One bounded-step chunk of the resident frontier."""
+        """Dispatch one bounded-step chunk of the resident frontier (async
+        — the chunk's status is consumed at the NEXT scheduler round).
+
+        The step limit is computed in-graph from the frontier's own
+        counter, so no host fetch is needed to dispatch; the old
+        per-round ``int(state.steps)`` scalar fetch and full-state
+        ``block_until_ready`` are gone (round 8)."""
         if self.state is None or all(s is None for s in self.slots):
             return
-        if self.engine.handicap_s:
-            # The engine's slow-node simulator applies per resident chunk,
-            # exactly as it does per static-flight chunk.
-            time.sleep(self.engine.handicap_s)
-        if int(self.state.steps) > _REBASE_STEPS:
-            self.state = self.state._replace(steps=jnp.int32(0))
+        if self._status is not None and self._status["steps"] > _REBASE_STEPS:
+            # Rebase both monotone counters well before int32 overflow:
+            # limits are relative, and the occupancy histogram is computed
+            # from in-graph deltas, so zeroing lane_rounds (which a
+            # never-retiring resident frontier grows forever — a latent
+            # round-7 overflow) is invisible to every consumer.
+            self.state = self.state._replace(
+                steps=jnp.int32(0),
+                lane_rounds=jnp.zeros_like(self.state.lane_rounds),
+            )
         if self.config.step_impl == "fused":
             from distributed_sudoku_solver_tpu.ops.pallas_step import (
-                advance_frontier_fused as _advance_fn,
+                advance_frontier_fused_status as _advance_fn,
             )
         else:
             from distributed_sudoku_solver_tpu.utils.checkpoint import (
-                advance_frontier as _advance_fn,
+                advance_frontier_status as _advance_fn,
             )
-        limit = jnp.int32(int(self.state.steps) + self.rcfg.chunk_steps)
-        t0 = time.monotonic()
-        self.state = _advance_fn(self.state, limit, self.geom, self.config)
-        jax.block_until_ready(self.state)
-        self.chunk_wall.record(time.monotonic() - t0)
-        self.chunks += 1
+        self.state, self._pending_status = _advance_fn(
+            self.state, jnp.int32(self.rcfg.chunk_steps), self.geom, self.config
+        )
 
     def fail(self, exc: BaseException) -> None:
         """A device program died (compile/OOM): fail every job this flight
@@ -473,6 +588,7 @@ class ResidentFlight:
             self._pending.clear()
         stranded.extend(j for j in self.slots if j is not None)
         self.slots = [None] * self.n_slots
+        self._pending_status = None  # nobody will consume it
         for job in stranded:
             if not job.done.is_set():
                 job.error = reason

@@ -31,6 +31,7 @@ from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
 from distributed_sudoku_solver_tpu.ops.frontier import (
     Frontier,
     SolverConfig,
+    chunk_status,
     frontier_live,
     init_frontier,
     run_frontier,
@@ -43,12 +44,46 @@ def start_frontier(grids: jax.Array, geom: Geometry, config: SolverConfig) -> Fr
     return init_frontier(encode_grid(grids, geom), config)
 
 
-@functools.partial(jax.jit, static_argnames=("geom", "config"))
+@functools.partial(
+    jax.jit, static_argnames=("geom", "config"), donate_argnums=(0,)
+)
 def advance_frontier(
     state: Frontier, step_limit: jax.Array, geom: Geometry, config: SolverConfig
 ) -> Frontier:
-    """Run until every job resolves or ``state.steps`` reaches ``step_limit``."""
+    """Run until every job resolves or ``state.steps`` reaches ``step_limit``.
+
+    ``state`` is DONATED: the input frontier's buffers are reused for the
+    output (the stack tensor alone is lanes x S x n^2 uint32 — a fresh copy
+    per chunk was hundreds of MB of avoidable HBM traffic at bulk shapes).
+    Callers must rebind (``state = advance_frontier(state, ...)``) and never
+    touch the old reference again; reads of a donated-away array raise.
+    """
     return run_frontier(state, sudoku_csp(geom, config), config, step_limit=step_limit)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("geom", "config"), donate_argnums=(0,)
+)
+def advance_frontier_status(
+    state: Frontier, steps_delta: jax.Array, geom: Geometry, config: SolverConfig
+):
+    """One serving chunk: advance by at most ``steps_delta`` MORE rounds and
+    return ``(new_state, packed status)`` — the composite-step half of the
+    one-fetch serving contract (``ops/frontier.chunk_status``).
+
+    The step limit is computed IN-GRAPH (``state.steps + steps_delta``,
+    clamped to ``config.max_steps`` by ``run_frontier``), so the host never
+    needs the current step counter to dispatch the next chunk — which is
+    what lets the serving loops enqueue chunk k+1 before consuming chunk
+    k's status.  ``state`` is donated (see :func:`advance_frontier`).
+    """
+    new = run_frontier(
+        state,
+        sudoku_csp(geom, config),
+        config,
+        step_limit=state.steps + jnp.int32(steps_delta),
+    )
+    return new, chunk_status(state.steps, state.lane_rounds, new)
 
 
 def frontier_done(state: Frontier) -> bool:
@@ -132,6 +167,12 @@ def solve_batch_checkpointed(
     where the file left off — same compiled program, same search order, so
     the result is bit-identical to an uninterrupted run.  The file is
     removed on successful completion.
+
+    ``on_chunk`` observes the post-chunk frontier between dispatches.
+    Reading it inside the callback is safe (the chunk is synced), but the
+    next chunk DONATES the state's buffers to XLA — a reference retained
+    past the callback's return is invalidated, and a later read raises.
+    To keep a snapshot, copy to host first: ``jax.device_get(state)``.
     """
     grids = jnp.asarray(grids)
     ghash = grids_digest(grids)

@@ -1,0 +1,450 @@
+"""Round-8 serving contract: one fetch per chunk, zero state copies,
+always-ahead dispatch.
+
+Three properties are pinned here:
+
+* **Packed status word** (``ops/frontier.chunk_status`` /
+  ``unpack_status``): the one small array each serving chunk fetches
+  carries exactly what the old scattered fetches carried — steps, per-job
+  solved / has-work bitmasks, and the lane-occupancy delta histogram.
+* **Donation is invisible** (bit-exactness): every frontier-threading
+  program now donates its input state; on this CPU backend donation is
+  real (the input buffer is deleted and reused), so the donated-vs-
+  undonated A/B below is a genuine aliasing-correctness check, not a
+  no-op.
+* **Fetch-count guard**: the serving hot loops read device values ONLY
+  through ``serving.engine.host_fetch`` — wrapping that seam counts host
+  syncs, and the guard asserts exactly one ``status`` fetch per consumed
+  chunk (plus event/finalize fetches only where a job actually resolved).
+  A stray ``np.asarray`` added to a hot loop fails here instead of
+  silently re-adding ~100 ms/chunk through a tunneled device.
+
+``heavy_compile_guard`` is requested ONCE, by the first donation A/B
+test — that clears a crowded cache right before the donation section,
+whose undonated twins (composite first, the outsized fused twin two
+tests later) are this module's heavy compiles — per-test use would
+clear_caches()-storm the rest of the suite (ROADMAP timing note).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_sudoku_solver_tpu.serving.engine as engine_mod
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    attach_roots,
+    chunk_status,
+    detach,
+    frontier_live,
+    purge_jobs,
+    run_frontier,
+    shed_rows,
+    status_len,
+    unpack_status,
+)
+from distributed_sudoku_solver_tpu.ops.solve import sudoku_csp
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.scheduler import ResidentConfig
+from distributed_sudoku_solver_tpu.utils.checkpoint import (
+    advance_frontier_status,
+    start_frontier,
+)
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+SMALL = SolverConfig(min_lanes=8, stack_slots=16)
+FUSED_SMALL = SolverConfig(
+    min_lanes=8, stack_slots=16, step_impl="fused", fused_steps=2
+)
+RC = ResidentConfig(
+    job_slots=4, gang_lanes=4, queue_depth=32, attach_batch=4, chunk_steps=16
+)
+
+
+def wait_for(pred, timeout=30.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+def _host_tree(state):
+    return jax.tree_util.tree_map(np.asarray, state)
+
+
+def _device_tree(host):
+    return jax.tree_util.tree_map(jnp.asarray, host)
+
+
+def _mid_state_host(cfg, steps=6):
+    """A mid-search frontier as a HOST tree (re-deviced per consumer, so
+    donated programs can eat their copy without starving the next one)."""
+    grids = np.stack([HARD_9[0], HARD_9[1], EASY_9]).astype(np.int32)
+    state = start_frontier(jnp.asarray(grids), SUDOKU_9, cfg)
+    state, _ = advance_frontier_status(state, jnp.int32(steps), SUDOKU_9, cfg)
+    return _host_tree(state)
+
+
+# -- the packed status word ---------------------------------------------------
+
+
+@pytest.mark.parametrize("n_jobs", [1, 37])
+def test_status_word_roundtrip(n_jobs):
+    """chunk_status packs exactly what unpack_status recovers, including
+    multi-word bitmasks (37 jobs -> two 32-bit words per mask) and the
+    occupancy delta histogram."""
+    rng = np.random.RandomState(3 + n_jobs)
+    n_lanes, s = 16, 4
+    job = rng.randint(-1, n_jobs, size=n_lanes).astype(np.int32)
+    has_top = rng.rand(n_lanes) < 0.7
+    solved = rng.rand(n_jobs) < 0.3
+    prev_rounds = rng.randint(0, 5, size=n_lanes).astype(np.int32)
+    lane_rounds = prev_rounds + rng.randint(0, 9, size=n_lanes).astype(np.int32)
+    state = Frontier(
+        top=jnp.zeros((n_lanes, 9, 9), jnp.uint32),
+        has_top=jnp.asarray(has_top),
+        stack=jnp.zeros((n_lanes, s, 9, 9), jnp.uint32),
+        base=jnp.zeros(n_lanes, jnp.int32),
+        count=jnp.zeros(n_lanes, jnp.int32),
+        job=jnp.asarray(job),
+        solved=jnp.asarray(solved),
+        solution=jnp.zeros((n_jobs, 9, 9), jnp.uint32),
+        overflowed=jnp.zeros(n_jobs, bool),
+        nodes=jnp.zeros(n_jobs, jnp.int32),
+        sol_count=jnp.zeros(n_jobs, jnp.int32),
+        steps=jnp.int32(50),
+        sweeps=jnp.int32(0),
+        expansions=jnp.int32(0),
+        steals=jnp.int32(0),
+        lane_rounds=jnp.asarray(lane_rounds),
+    )
+    status = np.asarray(
+        jax.jit(chunk_status)(jnp.int32(42), jnp.asarray(prev_rounds), state)
+    )
+    assert status.shape == (status_len(n_jobs),)
+    info = unpack_status(status, n_jobs)
+    assert info["steps"] == 50
+    delta = lane_rounds - prev_rounds
+    assert info["live_sum"] == int(delta.sum())
+    want_hist = np.bincount(
+        np.clip((delta * 10) // (50 - 42), 0, 9), minlength=10
+    )
+    np.testing.assert_array_equal(info["hist"], want_hist)
+    np.testing.assert_array_equal(info["solved"], solved)
+    live = np.asarray(frontier_live(state))
+    want_work = np.zeros(n_jobs, bool)
+    for lane in np.flatnonzero(live):
+        want_work[job[lane]] = True
+    np.testing.assert_array_equal(info["has_work"], want_work)
+
+
+# -- donation safety ----------------------------------------------------------
+
+
+def test_donated_programs_bit_identical_to_undonated(heavy_compile_guard):
+    """Every donated frontier program produces output bit-identical to an
+    undonated twin of the same computation — donation changes buffer
+    ownership, never values.  Donation is real on this backend: the
+    donated-away input must raise on a later read."""
+    from distributed_sudoku_solver_tpu.serving.engine import _purge, _shed_jit
+
+    host = _mid_state_host(SMALL)
+    csp = sudoku_csp(SUDOKU_9, SMALL)
+
+    @jax.jit  # fresh executable, no donation
+    def undonated_advance(state, steps_delta):
+        new = run_frontier(
+            state, csp, SMALL, step_limit=state.steps + steps_delta
+        )
+        return new, chunk_status(state.steps, state.lane_rounds, new)
+
+    ref_state, ref_status = undonated_advance(_device_tree(host), jnp.int32(8))
+    donated_in = _device_tree(host)
+    got_state, got_status = advance_frontier_status(
+        donated_in, jnp.int32(8), SUDOKU_9, SMALL
+    )
+    for name, a, b in zip(
+        Frontier._fields, ref_state, got_state, strict=True
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    np.testing.assert_array_equal(np.asarray(ref_status), np.asarray(got_status))
+    with pytest.raises(RuntimeError):
+        np.asarray(donated_in.top)  # input really was donated away
+
+    # purge / shed (engine's donated wrappers vs the eager pure functions).
+    dead = jnp.asarray(np.array([True, False, False]))
+    ref = purge_jobs(_device_tree(host), dead)
+    got = _purge(_device_tree(host), dead)
+    for name, a, b in zip(Frontier._fields, ref, got, strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    ref_st, ref_rows, ref_valid = shed_rows(_device_tree(host), jnp.int32(0), 2)
+    got_st, got_rows, got_valid = _shed_jit(_device_tree(host), jnp.int32(0), 2)
+    np.testing.assert_array_equal(np.asarray(ref_rows), np.asarray(got_rows))
+    np.testing.assert_array_equal(np.asarray(ref_valid), np.asarray(got_valid))
+    for name, a, b in zip(Frontier._fields, ref_st, got_st, strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_donated_attach_detach_bit_identical():
+    """The resident flight's donated attach/detach wrappers vs the eager
+    ops, on the real resident shapes (gang-scoped lanes, slot rows)."""
+    from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+    from distributed_sudoku_solver_tpu.serving.scheduler import (
+        _attach_jit,
+        _detach_jit,
+        _init_resident,
+        resident_solver_config,
+    )
+
+    cfg = resident_solver_config(SMALL, SUDOKU_9, RC)
+    host = _host_tree(_init_resident(SUDOKU_9, cfg, RC.job_slots))
+    grids = np.zeros((RC.attach_batch, 9, 9), np.int32)
+    grids[0], grids[1] = EASY_9, HARD_9[0]
+    slot_ids = np.asarray([0, 2, -1, -1], np.int32)
+    ref = attach_roots(
+        _device_tree(host),
+        encode_grid(jnp.asarray(grids), SUDOKU_9),
+        jnp.asarray(slot_ids),
+        cfg.steal_gang,
+    )
+    got = _attach_jit(
+        _device_tree(host),
+        jnp.asarray(grids),
+        jnp.asarray(slot_ids),
+        SUDOKU_9,
+        cfg.steal_gang,
+    )
+    for name, a, b in zip(Frontier._fields, ref, got, strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    attached = _host_tree(got)
+    mask = jnp.asarray(np.array([True, False, True, False]))
+    ref_d = detach(_device_tree(attached), mask)
+    got_d = _detach_jit(_device_tree(attached), mask)
+    for name, a, b in zip(Frontier._fields, ref_d, got_d, strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_donated_fused_advance_bit_identical_to_undonated():
+    """The fused serving chunk program under donation vs an undonated twin
+    (the fused path's own layout gymnastics make this the surface most
+    likely to miscompile under aliasing)."""
+    from distributed_sudoku_solver_tpu.ops.pallas_step import (
+        _run_fused,
+        advance_frontier_fused_status,
+        frontier_to_fused,
+        fused_to_frontier,
+    )
+
+    host = _mid_state_host(FUSED_SMALL, steps=2)
+
+    cfg = FUSED_SMALL
+
+    @jax.jit  # fresh executable, no donation
+    def undonated(state, steps_delta):
+        limit = jnp.minimum(
+            state.steps + steps_delta, jnp.int32(cfg.max_steps)
+        )
+        fs = _run_fused(frontier_to_fused(state), SUDOKU_9, cfg, limit)
+        new = fused_to_frontier(fs)
+        return new, chunk_status(state.steps, state.lane_rounds, new)
+
+    ref_state, ref_status = undonated(_device_tree(host), jnp.int32(4))
+    got_state, got_status = advance_frontier_fused_status(
+        _device_tree(host), jnp.int32(4), SUDOKU_9, cfg
+    )
+    for name, a, b in zip(
+        Frontier._fields, ref_state, got_state, strict=True
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    np.testing.assert_array_equal(np.asarray(ref_status), np.asarray(got_status))
+
+
+# -- the fetch-count guard ----------------------------------------------------
+
+
+@pytest.fixture
+def counted_fetches(monkeypatch):
+    """Wrap THE fetch seam; every host sync in the serving hot loops lands
+    in the returned list as its tag."""
+    calls: list = []
+    orig = engine_mod.host_fetch
+
+    def counting(x, floor_s=0.0, tag="status"):
+        calls.append(tag)
+        return orig(x, floor_s=floor_s, tag=tag)
+
+    monkeypatch.setattr(engine_mod, "host_fetch", counting)
+    return calls
+
+
+def test_static_loop_exactly_one_sync_per_chunk(counted_fetches):
+    """A multi-chunk single-job static flight: every consumed chunk costs
+    exactly one 'status' fetch; the only other sync is the terminal
+    finalize.  A stray value read added to the hot loop shows up as an
+    unexplained extra call and fails here."""
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=2).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+        assert wait_for(lambda: not eng._flights, timeout=20)
+    finally:
+        eng.stop(timeout=2)
+    statuses = counted_fetches.count("status")
+    finalizes = counted_fetches.count("finalize")
+    assert statuses == eng.chunk_wall.snapshot()["count"], (
+        "status fetches must be exactly one per consumed chunk"
+    )
+    assert statuses >= 3, "workload too easy to exercise the chunk loop"
+    assert finalizes == 1
+    # A 1-job flight resolves at finalize, never mid-flight: no event
+    # fetches, and nothing else in the loop may sync at all.
+    assert len(counted_fetches) == statuses + finalizes, counted_fetches
+
+
+def test_resident_loop_exactly_one_sync_per_chunk(counted_fetches):
+    """The resident scheduler round: one 'status' fetch per consumed
+    chunk, one 'event' fetch on the single round where the tenant's
+    verdict is collected, and no terminal finalize (the frontier never
+    retires)."""
+    eng = SolverEngine(config=SMALL, max_batch=8, resident=RC).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+        rf = eng._resident[SUDOKU_9]
+        assert wait_for(lambda: all(s is None for s in rf.slots), timeout=20)
+        chunks = rf.chunks
+    finally:
+        eng.stop(timeout=2)
+    statuses = counted_fetches.count("status")
+    events = counted_fetches.count("event")
+    assert statuses == chunks, (
+        "resident status fetches must be exactly one per consumed chunk"
+    )
+    assert statuses >= 1
+    assert events == 1, "exactly one verdict collection for one tenant"
+    assert counted_fetches.count("finalize") == 0
+    assert len(counted_fetches) == statuses + events, counted_fetches
+
+
+# -- padded-bucket job dimension (flight frontiers pad to a power of two) -----
+
+
+def _drive_flight(eng, fl, max_passes=200):
+    for _ in range(max_passes):
+        if eng._advance_flight(fl):
+            return
+    raise AssertionError("flight did not finish")
+
+
+def test_non_pow2_flight_cancel_purges_against_padded_bucket():
+    """A 5-job flight pads its frontier to an 8-job bucket; the cancel
+    purge's dead mask must be bucket-sized, not len(jobs)-sized
+    (regression: a (5,) mask against (8,) state raised in the loop and
+    errored every job in the flight).  Driven by hand — the engine is
+    never started, so the flight advances deterministically."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9 as G9
+
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=4)
+    jobs = [eng.submit(HARD_9[i % 3]) for i in range(5)]
+    batch = []
+    while True:
+        got = eng._take_batch(wait=False)
+        if not got:
+            break
+        batch.extend(got)
+    assert len(batch) == 5
+    eng._launch_flights(G9, SMALL, batch)
+    assert len(eng._flights) == 1
+    fl = eng._flights[0]
+    assert fl.state.solved.shape[0] == 8  # padded bucket
+    eng._advance_flight(fl)  # chunk 0 in flight
+    eng.cancel(jobs[3].uuid)
+    _drive_flight(eng, fl)
+    assert jobs[3].cancelled and not jobs[3].solved
+    for i, j in enumerate(jobs):
+        if i != 3:
+            assert j.solved, (i, j.error)
+
+
+def test_wide_flight_status_bitmasks_use_padded_bucket_width():
+    """65 jobs pad to a 128-job bucket: the status word carries
+    ceil(128/32)=4 words per bitmask while ceil(65/32)=3 — unpacking at
+    the wrong width misaligns has_work behind solved's padding words and
+    the loop finalizes a still-searching flight early (regression)."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9 as G9
+
+    eng = SolverEngine(config=SMALL, max_batch=128, chunk_steps=4)
+    jobs = [eng.submit(HARD_9[i % 3] if i < 64 else EASY_9) for i in range(65)]
+    batch = []
+    while True:
+        got = eng._take_batch(wait=False)
+        if not got:
+            break
+        batch.extend(got)
+    assert len(batch) == 65
+    eng._launch_flights(G9, SMALL, batch)
+    assert len(eng._flights) == 1
+    fl = eng._flights[0]
+    assert fl.state.solved.shape[0] == 128  # padded bucket
+    _drive_flight(eng, fl)
+    for i, j in enumerate(jobs):
+        assert j.solved, (i, j.error)
+        assert not j.unsat
+
+
+# -- reaction lag of the always-ahead loop ------------------------------------
+
+
+def test_cancel_honored_within_two_chunk_boundaries():
+    """The pipelined loop reacts to a cancel at the next pass (the purge
+    dispatch needs no device data), i.e. within two chunk boundaries of
+    the cancel landing — the documented round-8 semantics."""
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, chunk_steps=1, handicap_s=0.05
+    ).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert wait_for(lambda: len(eng._flights) > 0, timeout=30)
+        fl = eng._flights[0]
+        chunks_at_cancel = fl.chunks
+        eng.cancel(j.uuid)
+        assert j.wait(30), "cancelled job must resolve promptly"
+        assert j.cancelled and not j.solved and not j.unsat
+        # done was set at the purge pass; at most 2 further dispatches had
+        # been enqueued when it happened (the in-flight chunk + the one
+        # dispatched in the same pass as the purge), +1 slack for the pass
+        # racing the cancel call itself.
+        assert fl.chunks - chunks_at_cancel <= 3, (
+            f"cancel took {fl.chunks - chunks_at_cancel} dispatches"
+        )
+        assert wait_for(lambda: not eng._flights, timeout=20)
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_deadline_honored_within_two_chunk_boundaries():
+    """Deadline expiry on the static path under the pipelined loop: the
+    job resolves within ~2 chunk walls of its deadline passing."""
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, chunk_steps=1, handicap_s=0.05
+    ).start()
+    try:
+        j = eng.submit(HARD_9[1], deadline_s=0.3)
+        assert j.wait(30)
+        assert j.error == "deadline expired"
+        assert not j.solved and not j.unsat
+        # Resolution latency: deadline + at most ~2 chunk walls (handicap
+        # floor per chunk) + generous container-load slack.
+        took = time.monotonic() - j.submitted_at
+        assert took < 0.3 + 5.0, f"deadline reaction took {took:.2f}s"
+        ok = eng.submit(EASY_9)
+        assert ok.wait(60) and ok.solved, "loop died after deadline purge"
+    finally:
+        eng.stop(timeout=2)
