@@ -87,6 +87,35 @@ def build_parser() -> argparse.ArgumentParser:
         help="resident admission-queue bound; beyond it /solve answers "
         "429 + Retry-After",
     )
+    ap.add_argument(
+        "--fault-retries",
+        type=int,
+        default=3,
+        help="per-job retry budget for transient device faults (OOM, "
+        "preemption, runtime errors) before the job fails "
+        "(serving/faults.py)",
+    )
+    ap.add_argument(
+        "--rebuild-cooldown",
+        type=float,
+        default=0.25,
+        help="seconds before a failed resident flight is rebuilt (its jobs "
+        "are requeued, not errored)",
+    )
+    ap.add_argument(
+        "--breaker-failures",
+        type=int,
+        default=3,
+        help="consecutive resident rebuild failures that open the circuit "
+        "breaker (admission then falls back to static flights)",
+    )
+    ap.add_argument(
+        "--breaker-cooldown",
+        type=float,
+        default=2.0,
+        help="seconds an open breaker waits before half-opening (the next "
+        "admission probes a rebuild)",
+    )
     ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")
     ap.add_argument("--heartbeat-s", type=float, default=1.0)
     ap.add_argument(
@@ -132,12 +161,20 @@ def make_engine(args) -> SolverEngine:
             gang_lanes=args.resident_gang,
             queue_depth=args.resident_queue,
         )
+    from distributed_sudoku_solver_tpu.serving.faults import RecoveryPolicy
+
     return SolverEngine(
         config=cfg,
         max_batch=args.max_batch,
         solve_fn=solve_fn,
         handicap_s=args.handicap / 1000.0,
         resident=resident,
+        recovery=RecoveryPolicy(
+            max_retries=args.fault_retries,
+            rebuild_cooldown_s=args.rebuild_cooldown,
+            breaker_failures=args.breaker_failures,
+            breaker_cooldown_s=args.breaker_cooldown,
+        ),
     )
 
 

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import base64
 import dataclasses
+import logging
 import socket
 import threading
 import time
@@ -52,7 +53,13 @@ import numpy as np
 from distributed_sudoku_solver_tpu.cluster import wire
 from distributed_sudoku_solver_tpu.cluster.wire import Addr, WireError, addr_str
 from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+from distributed_sudoku_solver_tpu.serving import faults
 from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
+
+# Diagnostics go through logging (stderr via the root handler / logging's
+# lastResort), not print(); failure-path messages carry the fault
+# classification and keep their grep-compatible "[addr]" prefixes.
+_LOG = logging.getLogger(__name__)
 
 
 def local_ip() -> str:
@@ -432,11 +439,7 @@ class ClusterNode:
             t.start()
             self._threads.append(t)
         if self.anchor is not None:
-            wire.send_msg(
-                self.anchor,
-                {"method": "JOIN_REQ", "addr": self.addr_s},
-                self.config.io_timeout_s,
-            )
+            self._send(self.anchor, {"method": "JOIN_REQ", "addr": self.addr_s})
         return self
 
     def stop(self, graceful: bool = True) -> None:
@@ -444,10 +447,8 @@ class ClusterNode:
         self._stop.set()
         if graceful and self.coordinator != self.addr_s:
             try:
-                wire.send_msg(
-                    wire.parse_addr(self.coordinator),
-                    {"method": "LEAVE", "addr": self.addr_s},
-                    self.config.io_timeout_s,
+                self._send(
+                    self.coordinator, {"method": "LEAVE", "addr": self.addr_s}
                 )
             except WireError:
                 pass
@@ -469,6 +470,33 @@ class ClusterNode:
             pred = self.network[(i - 1) % len(self.network)]
             succ = self.network[(i + 1) % len(self.network)]
             return pred, succ
+
+    # -- wire egress ---------------------------------------------------------
+    def _send(self, peer, payload: dict) -> None:
+        """The node's single wire-egress seam: every outbound cluster
+        message leaves through here (all egress shares ``io_timeout_s``),
+        so the fault-injection plane (``serving/faults.py``) can fail sends
+        deterministically and the existing WireError recovery paths —
+        ledger re-execution, part re-entry/local fallback, heartbeat
+        suspicion — are exercised end to end.  ``peer`` is an addr string
+        or a parsed ``Addr``.  An injected fault surfaces as
+        :class:`WireError` whatever its class: to the *sender*, any failed
+        send is just an undeliverable message, and the re-dispatch
+        machinery (not this seam) owns the classification."""
+        if faults.active() is not None:  # skip uuid extraction in production
+            try:
+                faults.fire(
+                    "cluster.send",
+                    uuids=tuple(
+                        str(payload[k])
+                        for k in ("uuid", "part")
+                        if payload.get(k) is not None
+                    ),
+                )
+            except faults.SimulatedFault as e:
+                raise WireError(f"injected send fault: {e}") from e
+        addr = peer if isinstance(peer, tuple) else wire.parse_addr(peer)
+        wire.send_msg(addr, payload, self.config.io_timeout_s)
 
     # -- background loops ----------------------------------------------------
     def _accept_loop(self) -> None:
@@ -493,7 +521,10 @@ class ClusterNode:
                 # (RuntimeError covers "engine stopped" during teardown);
                 # reliability comes from sender-side errors, not server retries.
                 if not self._stop.is_set():
-                    print(f"[{self.addr_s}] bad message: {e!r}")
+                    _LOG.error(
+                        "[%s] bad message: %r [%s]",
+                        self.addr_s, e, faults.classify(e),
+                    )
 
     def _hb_loop(self) -> None:
         while not self._stop.is_set():
@@ -513,22 +544,14 @@ class ClusterNode:
                     self._last_hb = time.monotonic()
                 continue
             try:
-                wire.send_msg(
-                    wire.parse_addr(succ),
-                    {"method": "HEARTBEAT", "from": self.addr_s},
-                    self.config.io_timeout_s,
-                )
+                self._send(succ, {"method": "HEARTBEAT", "from": self.addr_s})
             except WireError:
                 pass  # successor's own detector handles its death
             # Receiver-initiated stealing (``DHT_Node.py:246-248``): idle ->
             # ask my ring predecessor for a slice of a live search.
             if self.config.needwork and self.engine.busy_depth() == 0:
                 try:
-                    wire.send_msg(
-                        wire.parse_addr(pred),
-                        {"method": "NEEDWORK", "addr": self.addr_s},
-                        self.config.io_timeout_s,
-                    )
+                    self._send(pred, {"method": "NEEDWORK", "addr": self.addr_s})
                 except WireError:
                     pass
             limit = self.config.heartbeat_s * self.config.fail_factor
@@ -584,7 +607,7 @@ class ClusterNode:
                 },
             )
         else:
-            print(f"[{self.addr_s}] unknown method {method!r}")
+            _LOG.warning("[%s] unknown method %r", self.addr_s, method)
 
     # -- membership ----------------------------------------------------------
     def _broadcast_network(self) -> None:
@@ -600,17 +623,13 @@ class ClusterNode:
         for m in members:
             if m != self.addr_s:
                 try:
-                    wire.send_msg(wire.parse_addr(m), payload, self.config.io_timeout_s)
+                    self._send(m, payload)
                 except WireError:
                     pass  # its detector will notice soon enough
 
     def _on_join_req(self, joiner: str) -> None:
         if self.coordinator != self.addr_s:
-            wire.send_msg(
-                wire.parse_addr(self.coordinator),
-                {"method": "JOIN_REQ", "addr": joiner},
-                self.config.io_timeout_s,
-            )
+            self._send(self.coordinator, {"method": "JOIN_REQ", "addr": joiner})
             return
         with self._lock:
             if joiner not in self.network:
@@ -642,10 +661,8 @@ class ClusterNode:
         self._recover_parts()
         if rejoin:
             try:
-                wire.send_msg(
-                    wire.parse_addr(coordinator),
-                    {"method": "JOIN_REQ", "addr": self.addr_s},
-                    self.config.io_timeout_s,
+                self._send(
+                    coordinator, {"method": "JOIN_REQ", "addr": self.addr_s}
                 )
             except WireError:
                 pass
@@ -668,10 +685,8 @@ class ClusterNode:
             self._recover_parts()
         else:
             try:
-                wire.send_msg(
-                    wire.parse_addr(self.coordinator),
-                    {"method": "NODE_FAILED", "addr": dead},
-                    self.config.io_timeout_s,
+                self._send(
+                    self.coordinator, {"method": "NODE_FAILED", "addr": dead}
                 )
             except WireError:
                 pass
@@ -746,11 +761,7 @@ class ClusterNode:
             self._on_cancel(job_uuid)
             return
         try:
-            wire.send_msg(
-                wire.parse_addr(peer),
-                {"method": "CANCEL", "uuid": job_uuid},
-                self.config.io_timeout_s,
-            )
+            self._send(peer, {"method": "CANCEL", "uuid": job_uuid})
         except WireError:
             pass
 
@@ -869,8 +880,8 @@ class ClusterNode:
             }
         self._track(member, +1)
         try:
-            wire.send_msg(
-                wire.parse_addr(member),
+            self._send(
+                member,
                 {
                     "method": "TASK",
                     "uuid": job.uuid,
@@ -878,7 +889,6 @@ class ClusterNode:
                     "origin": self.addr_s,
                     "config": cfg_dict,
                 },
-                self.config.io_timeout_s,
             )
         except WireError:
             # Reliable transport tells us delivery failed -> immediate local
@@ -955,9 +965,7 @@ class ClusterNode:
                 else None,
             }
             try:
-                wire.send_msg(
-                    wire.parse_addr(origin), payload, self.config.io_timeout_s
-                )
+                self._send(origin, payload)
             except WireError:
                 pass  # origin died; its successor's repair already re-executed
 
@@ -1008,19 +1016,18 @@ class ClusterNode:
                 # would spam a long search into the megabytes).
                 if not ex.progress_skip_warned:
                     ex.progress_skip_warned = True
-                    print(
-                        f"[cluster] progress snapshot for {ex.uuid[:8]} "
-                        f"skipped: {rows.shape[0]} rows > progress_max_rows="
-                        f"{self.config.progress_max_rows} — resume degrades "
-                        f"to root re-execution (progress_skipped counter on "
-                        f"/metrics)"
+                    _LOG.warning(
+                        "[cluster] progress snapshot for %s skipped: %d rows "
+                        "> progress_max_rows=%d — resume degrades to root "
+                        "re-execution (progress_skipped counter on /metrics)",
+                        ex.uuid[:8], rows.shape[0], self.config.progress_max_rows,
                     )
                 with self._lock:  # one _progress_loop thread PER JOB writes
                     self.progress_skipped += 1
                 continue
             try:
-                wire.send_msg(
-                    wire.parse_addr(origin),
+                self._send(
+                    origin,
                     {
                         "method": "PROGRESS",
                         "uuid": ex.uuid,
@@ -1028,7 +1035,6 @@ class ClusterNode:
                         "nodes": int(nodes) + ex.base_nodes,
                         "config": job_cfg,
                     },
-                    self.config.io_timeout_s,
                 )
             except WireError:
                 return  # origin unreachable; repair will reassign anyway
@@ -1064,9 +1070,7 @@ class ClusterNode:
             "report_to": self.addr_s,
         }
         try:
-            wire.send_msg(
-                wire.parse_addr(requester), payload, self.config.io_timeout_s
-            )
+            self._send(requester, payload)
             self.subtasks_sent += 1
         except WireError:
             # Requester vanished between NEEDWORK and now: run the part
@@ -1110,9 +1114,7 @@ class ClusterNode:
                 self._on_part_result(payload)
                 return
             try:
-                wire.send_msg(
-                    wire.parse_addr(report_to), payload, self.config.io_timeout_s
-                )
+                self._send(report_to, payload)
             except WireError:
                 pass  # shedder died; the origin's repair path re-covers this
 
@@ -1162,7 +1164,10 @@ class ClusterNode:
         except Exception as e:  # noqa: BLE001 - e.g. our own engine stopping
             ex.unmark_rehomed(part_uuid)
             if not self._stop.is_set():
-                print(f"[{self.addr_s}] part re-entry failed: {e!r}")
+                _LOG.error(
+                    "[%s] part re-entry failed: %r [%s]",
+                    self.addr_s, e, faults.classify(e),
+                )
 
     def _on_part_result(self, msg: dict) -> None:
         with self._lock:
@@ -1186,15 +1191,24 @@ class ClusterNode:
             # chance.  Found by the round-4 device-backed churn soak (one
             # lost job in 2 h of churn; the oracle-backed lane's instant
             # solves could not hit the window).  Re-execute from the ledger
-            # immediately — faster than waiting for the heartbeat deadline;
-            # a deterministic config error simply fails once more locally
-            # and surfaces with its error set (budget exhaustion carries no
-            # error and still finalizes normally).
-            with self._lock:
-                known = msg["uuid"] in self._ledger
-            if known:
-                self._reexecute(msg["uuid"])
-            return
+            # immediately — faster than waiting for the heartbeat deadline.
+            # Since round 9 the re-dispatch decision uses the same
+            # classifier as the engine's own recovery (serving/faults.py):
+            # a TRANSIENT remote failure (shutdown race, preemption,
+            # injected wire fault — and a remote retry-budget exhaustion,
+            # whose "retry budget exhausted...: <transient fault>" text
+            # classifies transient ON PURPOSE: the remote's storm may be
+            # node-local, so one local re-execution is a fair last try)
+            # re-executes from the ledger; a PERMANENT one (bad config,
+            # poisoned job — an error retrying cannot cure) finalizes the
+            # client's job with that error instead of burning a local
+            # re-execution that must fail identically.
+            if faults.classify_message(msg.get("error")) == faults.TRANSIENT:
+                with self._lock:
+                    known = msg["uuid"] in self._ledger
+                if known:
+                    self._reexecute(msg["uuid"])
+                return
         with self._lock:
             entry = self._ledger.pop(msg["uuid"], None)
         if entry is None:

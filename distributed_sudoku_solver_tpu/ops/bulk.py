@@ -321,6 +321,8 @@ def solve_bulk(
 
     import time as _time
 
+    from distributed_sudoku_solver_tpu.serving import faults
+
     stage = {"pack_s": 0.0, "drain_s": 0.0} if trace is not None else None
 
     def drain(lo: int, res) -> None:
@@ -351,6 +353,9 @@ def solve_bulk(
         for lo in range(0, b, chunk):
             batch = pad_to(grids[lo : lo + chunk], chunk)
             t0 = _time.perf_counter()
+            # Fault-injection seam: the mass-pass twin of the rung seam
+            # below (the HTTP endpoint retries transient chunk failures).
+            faults.fire("bulk.dispatch")
             res = run_chunk(batch, first_cfg)
             if stage is not None:
                 stage["pack_s"] += _time.perf_counter() - t0
@@ -411,6 +416,10 @@ def solve_bulk(
         state = _rung_start(jnp.asarray(batch.astype(np.uint8)), geom, scfg)
         n_rung_jobs = len(batch)
         while True:
+            # Fault-injection seam (serving/faults.py): a raise here fails
+            # the whole rung dispatch loop; the HTTP bulk endpoint retries
+            # transient chunk failures under the engine's recovery policy.
+            faults.fire("bulk.dispatch")
             state, status = _advance(
                 state, jnp.int32(config.dispatch_steps), geom, scfg
             )

@@ -4,6 +4,12 @@ from distributed_sudoku_solver_tpu.serving.engine import (  # noqa: F401
     Job,
     SolverEngine,
 )
+from distributed_sudoku_solver_tpu.serving.faults import (  # noqa: F401
+    CircuitBreaker,
+    FaultInjector,
+    FaultSchedule,
+    RecoveryPolicy,
+)
 from distributed_sudoku_solver_tpu.serving.portfolio import (  # noqa: F401
     DEFAULT_PORTFOLIO,
     PortfolioResult,

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import queue
 import threading
 import time
@@ -55,6 +56,13 @@ import numpy as np
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
 from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+from distributed_sudoku_solver_tpu.serving import faults
+
+# Diagnostics go through logging (stderr via the root handler / logging's
+# lastResort), not print(): failure paths log at ERROR with the fault
+# classification, policy decisions (downgrades, unfit configs) at WARNING.
+# Message text is kept grep-compatible with the old prints ("[engine] ...").
+_LOG = logging.getLogger(__name__)
 
 
 def host_fetch(x, floor_s: float = 0.0, tag: str = "status"):
@@ -79,6 +87,7 @@ def host_fetch(x, floor_s: float = 0.0, tag: str = "status"):
     under the always-ahead loop they also wait out the in-flight chunk).
     ``x`` may be a pytree; the result is the matching numpy tree.
     """
+    faults.fire("fetch." + tag)
     if floor_s:
         time.sleep(floor_s)
     return jax.device_get(x)
@@ -122,6 +131,15 @@ class Job:
     # uninterruptible dispatch).
     deadline: Optional[float] = None
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    # Self-healing bookkeeping (serving/faults.py): transient re-dispatches
+    # consumed from the per-job retry budget, the classification of the
+    # last fault that requeued this job, and the bisection group token —
+    # requeued halves of a permanently-failing batch must NOT re-merge at
+    # the (geometry, config) grouping, or the poison-job isolation search
+    # would never converge.
+    fault_retries: int = 0
+    last_fault: Optional[str] = None
+    bisect_token: Optional[int] = None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -191,6 +209,7 @@ class SolverEngine:
         max_flights: int = 4,
         handicap_s: float = 0.0,
         resident=None,  # Optional[serving.scheduler.ResidentConfig]
+        recovery: Optional[faults.RecoveryPolicy] = None,
     ):
         self.config = config
         self.max_batch = max_batch
@@ -267,6 +286,23 @@ class SolverEngine:
         # the config's (geometry, stack depth, lane width) sits outside the
         # kernel's measured compile boundary (see _fit_fused).
         self.fused_downgrades = 0
+        # Self-healing recovery (serving/faults.py): transient device-side
+        # failures requeue their jobs under a per-job retry budget with
+        # degraded fallbacks; permanent failures bisect multi-job batches
+        # until the poison job is isolated.  All counters below are
+        # single-writer on the device loop (except fault_bulk_retries,
+        # bumped by HTTP bulk threads — readers tolerate staleness) and
+        # exported as the /metrics "faults" section.
+        self.recovery = recovery or faults.RecoveryPolicy()
+        self.fault_retries_total = 0  # transient re-dispatches granted
+        self.fault_requeues = 0  # jobs put back on the queue by recovery
+        self.fault_downgrades_fused = 0  # fused -> composite retry rung
+        self.fault_lane_halvings = 0  # OOM retry rung: halved flight width
+        self.fault_bisections = 0  # permanently-failing batches split
+        self.fault_budget_exhausted = 0  # jobs failed out of retries
+        self.fault_permanent = 0  # jobs failed on an isolated permanent fault
+        self.fault_bulk_retries = 0  # transient bulk-chunk re-dispatches (http)
+        self._bisect_seq = 0  # bisection group token source
         # Per-dispatch lane-occupancy histogram for fused flights (ROADMAP
         # 4b evidence): the kernel counts, per lane, how many in-kernel
         # rounds it held live work (Frontier.lane_rounds); the advance
@@ -342,15 +378,20 @@ class SolverEngine:
         rf = self._resident_for(job.geom)
         if rf is None:
             return False
-        if rf.try_admit(job):
+        verdict = rf.admit(job)
+        if verdict == rf.ADMITTED:
             return True
-        if saturation == "reject":
+        if verdict == rf.SATURATED and saturation == "reject":
+            # Only genuine backpressure may 429: a healthy-but-full queue.
             from distributed_sudoku_solver_tpu.serving.scheduler import (
                 EngineSaturated,
             )
 
             raise EngineSaturated(rf.retry_after_s())
-        return False  # fall back to a static flight
+        # Saturated with quiet fallback, or DEFLECTED (breaker open /
+        # flight permanently closed — a broken resident program must not
+        # read as client backpressure): serve on a static flight.
+        return False
 
     def _resident_for(self, geom: Geometry):
         """The geometry's resident flight, created on first eligible submit
@@ -371,7 +412,7 @@ class SolverEngine:
             except ValueError as e:
                 self.resident_unfit += 1
                 self._resident[geom] = None  # don't re-derive per submit
-                print(f"[engine] resident flight unfit for {geom}: {e}")
+                _LOG.warning("[engine] resident flight unfit for %s: %s", geom, e)
                 return None
             self._resident[geom] = rf
             return rf
@@ -572,6 +613,31 @@ class SolverEngine:
             }
         if self.resident_unfit:
             out["resident_unfit"] = int(self.resident_unfit)
+        # Self-healing observability (serving/faults.py): retry/requeue/
+        # downgrade/bisection counters, per-geometry breaker state, and —
+        # when a fault injector is installed — what it injected where.
+        fa = {
+            "retries": int(self.fault_retries_total),
+            "requeues": int(self.fault_requeues),
+            "downgrades": {
+                "fused_to_composite": int(self.fault_downgrades_fused),
+                "lanes_halved": int(self.fault_lane_halvings),
+            },
+            "bisections": int(self.fault_bisections),
+            "budget_exhausted": int(self.fault_budget_exhausted),
+            "permanent_failures": int(self.fault_permanent),
+            "bulk_retries": int(self.fault_bulk_retries),
+        }
+        breaker = {
+            f"{rf.geom.n}x{rf.geom.n}": rf.breaker.metrics()
+            for rf in resident_flights
+        }
+        if breaker:
+            fa["breaker"] = breaker
+        inj = faults.active()
+        if inj is not None:
+            fa["injector"] = inj.metrics()
+        out["faults"] = fa
         if self._occ_chunks > 0:
             # Lane-occupancy inside fused dispatches: counts[k] = lanes
             # observed live for [10k, 10(k+1))% of the rounds their chunk
@@ -637,51 +703,191 @@ class SolverEngine:
                     live.append(job)
             by_key: dict[tuple, list[Job]] = {}
             for job in live:
-                by_key.setdefault((job.geom, job.config or self.config), []).append(job)
-            for (geom, cfg), group in by_key.items():
+                # bisect_token keeps requeued halves of a permanently-
+                # failing batch apart; it is None for every healthy job.
+                by_key.setdefault(
+                    (job.geom, job.config or self.config, job.bisect_token), []
+                ).append(job)
+            for (geom, cfg, _token), group in by_key.items():
                 # The device loop must survive anything a batch throws
-                # (compile error, bad config, OOM): fail the batch's jobs,
-                # keep serving — a dead loop would strand every later job.
+                # (compile error, bad config, OOM): recover the batch's
+                # jobs (serving/faults.py — transient faults requeue under
+                # a retry budget, permanent ones bisect/fail), keep
+                # serving — a dead loop would strand every later job.
                 try:
                     if self._use_flights:
                         self._launch_flights(geom, cfg, group)
                     else:
                         self._solve_group(geom, group, cfg)
                 except Exception as e:  # noqa: BLE001
-                    for job in group:
-                        if not job.done.is_set():
-                            job.error = f"{type(e).__name__}: {e}"
-                            job.done.set()
-                    print(f"[engine] batch failed ({geom}): {e!r}")
+                    _LOG.error(
+                        "[engine] batch failed (%s): %r [%s]",
+                        geom, e, faults.classify(e),
+                    )
+                    self._recover_group(group, cfg, e)
             self._service_controls()
             # Resident flights advance one chunk each, interleaved with the
-            # static flights below (same chunk-granularity fairness).
-            for rf in resident:
+            # static flights below (same chunk-granularity fairness).  A
+            # COOLING flight with queued jobs is stepped too: step() only
+            # sweeps its pending queue (cancels/deadlines) mid-cooldown —
+            # active() stays False so the wait logic above still sleeps.
+            stepable = list(resident)
+            for rf in self._resident_flights():
+                if rf not in stepable and rf.cooling() and rf.queued_depth():
+                    stepable.append(rf)
+            for rf in stepable:
                 try:
                     rf.step()
                 except Exception as e:  # noqa: BLE001
-                    # A resident device program died: fail its jobs, close
-                    # admission (future submits fall back to static
-                    # flights), keep the loop serving.
-                    rf.fail(e)
-                    with self._lock:
-                        self._resident[rf.geom] = None
-                    print(f"[engine] resident flight failed ({rf.geom}): {e!r}")
+                    # A resident device program died: classify and recover
+                    # (serving/scheduler.py) — a transient fault rebuilds
+                    # the flight after a cooldown with its jobs requeued, a
+                    # permanent one (or a tripped circuit breaker) routes
+                    # them to static flights; the loop keeps serving.
+                    _LOG.error(
+                        "[engine] resident flight failed (%s): %r [%s]",
+                        rf.geom, e, faults.classify(e),
+                    )
+                    rf.on_failure(e)
             # Round-robin: advance every active flight by one chunk.
             for fl in list(self._flights):
                 try:
                     finished = self._advance_flight(fl)
                 except Exception as e:  # noqa: BLE001
-                    for job in fl.jobs:
-                        if not job.done.is_set():
-                            job.error = f"{type(e).__name__}: {e}"
-                            job.done.set()
                     self._flights.remove(fl)
-                    print(f"[engine] flight failed ({fl.geom}): {e!r}")
+                    _LOG.error(
+                        "[engine] flight failed (%s): %r [%s]",
+                        fl.geom, e, faults.classify(e),
+                    )
+                    self._recover_jobs(
+                        [j for j in fl.jobs if not j.done.is_set()],
+                        fl.config,
+                        e,
+                    )
                     continue
                 if finished:
                     self._flights.remove(fl)
         self._drain_on_stop()
+
+    # -- fault recovery (serving/faults.py) -----------------------------------
+    def _recover_group(self, group: list[Job], cfg, exc) -> None:
+        """A batch failed at launch: recover every job not already owned by
+        a flight (``_launch_flights`` may have launched some of the group
+        before the raise — those flights are live and keep their jobs)."""
+        owned = {id(j) for fl in self._flights for j in fl.jobs}
+        self._recover_jobs(
+            [j for j in group if id(j) not in owned and not j.done.is_set()],
+            cfg,
+            exc,
+        )
+
+    def _recover_jobs(self, jobs: list[Job], cfg: SolverConfig, exc) -> None:
+        """Classify-and-recover for a failed dispatch's unresolved jobs.
+
+        Transient: every job re-enters the queue under its retry budget,
+        with the degraded fallback config for the fault's shape (fused ->
+        composite; OOM -> halved lanes).  Permanent: a multi-job batch is
+        BISECTED — both halves requeue under fresh group tokens, so
+        repeated failures converge on the one poison job, which then fails
+        alone instead of taking its batchmates down.  The device state is
+        gone either way (donated buffers do not survive a failed program),
+        so a recovered job restarts from its grid/roots — sound, since
+        neither path ever reported partial results.
+        """
+        if not jobs:
+            return
+        kind = faults.classify(exc)
+        label = f"{type(exc).__name__}: {exc}"
+        if kind == faults.PERMANENT:
+            if len(jobs) > 1:
+                self.fault_bisections += 1
+                mid = len(jobs) // 2
+                for half in (jobs[:mid], jobs[mid:]):
+                    self._bisect_seq += 1
+                    for job in half:
+                        job.bisect_token = self._bisect_seq
+                        job.last_fault = kind
+                        self._requeue(job)
+                _LOG.error(
+                    "[engine] permanent batch failure: bisecting %d jobs "
+                    "to isolate the poison dispatch", len(jobs),
+                )
+            else:
+                for job in jobs:
+                    job.error = label
+                    job.done.set()
+                    self.fault_permanent += 1
+            return
+        degraded = self._degrade(cfg, exc)
+        for job in jobs:
+            if not self._charge_retry(job, kind, label):
+                continue
+            # Pin the (possibly degraded) config on the job: the requeue
+            # must not re-enter the resident path (that flight has its own
+            # breaker) and must group under the degraded config.
+            job.config = degraded
+            self._requeue(job)
+
+    def _charge_retry(self, job: Job, kind: str, label: str) -> bool:
+        """Charge one transient retry against ``job``'s budget.  False =
+        budget exhausted: the job is failed AND resolved here (the error
+        text is load-bearing — cluster ``_on_solution`` classifies it via
+        ``classify_message`` and tests assert on it).  Shared by the static
+        recovery above and ``ResidentFlight.on_failure``."""
+        job.fault_retries += 1
+        job.last_fault = kind
+        if job.fault_retries > self.recovery.max_retries:
+            job.error = (
+                f"retry budget exhausted after "
+                f"{job.fault_retries - 1} retries: {label}"
+            )
+            job.done.set()
+            self.fault_budget_exhausted += 1
+            return False
+        self.fault_retries_total += 1
+        return True
+
+    def _requeue(self, job: Job) -> None:
+        # Device-loop thread only.  Straight to the queue (not _enqueue):
+        # recovery during stop() is fine — _drain_on_stop sweeps the queue
+        # after the loop exits, so a requeued job still resolves.
+        self._queue.put(job)
+        self.fault_requeues += 1
+
+    def _degrade(self, cfg: SolverConfig, exc) -> SolverConfig:
+        """One rung down the fallback ladder for a transient retry: an OOM
+        halves the flight's lane width (attacking the allocation that
+        failed), any other fault on a fused config downgrades to the
+        composite step (the slower, always-correct path) — mirroring
+        ``_fit_fused``'s launch-time policy of degrading instead of
+        erroring paying jobs.
+
+        The halved width is PINNED (even for auto-width configs): a pinned
+        width is a per-flight cap — ``_launch_flights`` splits oversized
+        groups at ``cap=lanes`` and ``_start_flight`` shrinks the bucket to
+        it — so the retry really allocates half the frontier PER PROGRAM,
+        and ``resolve_lanes`` can never see more jobs than lanes.  Scope
+        honestly stated: this rung attacks per-program peaks (fused VMEM
+        admission, XLA temp buffers — the dominant OOM mode on this
+        stack); a multi-job group split into more flights keeps roughly
+        the same AGGREGATE persistent frontier HBM, which no width cap can
+        shrink — only the retry budget bounds that failure mode."""
+        if faults.is_oom(exc):
+            lanes = cfg.lanes if cfg.lanes > 0 else cfg.min_lanes
+            halved = max(1, lanes // 2)
+            self.fault_lane_halvings += 1
+            new = dataclasses.replace(
+                cfg, lanes=halved, min_lanes=min(cfg.min_lanes, halved)
+            )
+            if new.steal_gang > 0 and halved % new.steal_gang:
+                # Gang-scoped stealing needs gang | lanes; a halved width
+                # that breaks divisibility drops to global pairing.
+                new = dataclasses.replace(new, steal_gang=0)
+            return new
+        if cfg.step_impl == "fused":
+            self.fault_downgrades_fused += 1
+            return dataclasses.replace(cfg, step_impl="xla")
+        return cfg
 
     def _drain_on_stop(self) -> None:
         """Resolve everything still pending when the loop exits: nobody else
@@ -736,7 +942,9 @@ class SolverEngine:
             )
         except ValueError as e:
             self.fused_downgrades += 1
-            print(f"[engine] fused config unfit, downgrading to composite: {e}")
+            _LOG.warning(
+                "[engine] fused config unfit, downgrading to composite: %s", e
+            )
             return dataclasses.replace(cfg, step_impl="xla")
 
     def _launch_flights(
@@ -793,6 +1001,8 @@ class SolverEngine:
         roots[: len(r)] = r
         valid = np.arange(bucket) < len(r)
         cfg = self._fit_fused(geom, cfg, cfg.resolve_lanes_packed(bucket))
+        if faults.active() is not None:
+            faults.fire("engine.launch", uuids=(job.uuid,))
         state = _start_packed(jnp.asarray(roots), jnp.asarray(valid), cfg)
         self._flights.append(_Flight(geom=geom, config=cfg, jobs=[job], state=state))
 
@@ -814,6 +1024,8 @@ class SolverEngine:
         roots[: len(jobs)] = np.asarray(encode_grid(jnp.asarray(grids), geom), np.uint32)
         job_of_root[: len(jobs)] = np.arange(len(jobs), dtype=np.int32)
         cfg = self._fit_fused(geom, cfg, cfg.resolve_lanes(bucket))
+        if faults.active() is not None:
+            faults.fire("engine.launch", uuids=tuple(j.uuid for j in jobs))
         state = _start_roots(
             jnp.asarray(roots), jnp.asarray(job_of_root), bucket, cfg
         )
@@ -889,6 +1101,11 @@ class SolverEngine:
                 advance_frontier_status as _advance,
             )
 
+        if faults.active() is not None:  # don't build uuid tuples per chunk
+            faults.fire(
+                "engine.advance",
+                uuids=tuple(j.uuid for j in fl.jobs if not j.done.is_set()),
+            )
         fl.state, status_dev = _advance(
             fl.state, jnp.int32(self.chunk_steps), fl.geom, fl.config
         )
@@ -1035,7 +1252,10 @@ class SolverEngine:
             except Exception as e:  # noqa: BLE001
                 req.result = None
                 req.error = f"{type(e).__name__}: {e}"
-                print(f"[engine] control {req.kind} failed: {e!r}")
+                _LOG.error(
+                    "[engine] control %s failed: %r [%s]",
+                    req.kind, e, faults.classify(e),
+                )
             finally:
                 req.done.set()
 

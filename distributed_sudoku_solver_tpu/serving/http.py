@@ -273,15 +273,41 @@ class _Handler(BaseHTTPRequestHandler):
         unsat = np.zeros(len(grids), bool)
         solutions = np.zeros_like(grids)
         # Mass pass: one run_exclusive per chunk (rung-free, step-capped).
+        # A chunk that fails with a TRANSIENT error (preemption, OOM,
+        # runtime hiccup — serving/faults.py taxonomy) is re-dispatched
+        # under the engine's recovery policy before the endpoint gives up;
+        # permanent errors (and exhausted budgets) still answer 500.
+        from distributed_sudoku_solver_tpu.serving import faults
+
         for lo in range(0, len(grids), cfg.chunk):
             sl = grids[lo : lo + cfg.chunk]
-            try:
-                res = engine.run_exclusive(
-                    lambda sl=sl: solve_bulk(sl, geom, cfg),
-                    timeout=max(1.0, deadline - time.time()),
-                )
-            except RuntimeError as e:  # chunk failed (compile/OOM): permanent
-                return self._send(500, {"error": str(e), "done": int(lo)})
+            attempts = 0
+            while True:
+                try:
+                    res = engine.run_exclusive(
+                        lambda sl=sl: solve_bulk(sl, geom, cfg),
+                        timeout=max(1.0, deadline - time.time()),
+                    )
+                    break
+                except RuntimeError as e:
+                    if (
+                        faults.classify_message(str(e)) == faults.TRANSIENT
+                        and attempts < engine.recovery.max_retries
+                        and time.time() < deadline
+                    ):
+                        attempts += 1
+                        with engine._lock:  # handler threads race this bump
+                            engine.fault_bulk_retries += 1
+                        # Short exponential pause so one brief device
+                        # outage doesn't burn the whole budget back-to-back
+                        # (the engine path gets this implicitly via its
+                        # requeue latency); capped by the request deadline.
+                        time.sleep(
+                            min(0.05 * 2**attempts, 1.0,
+                                max(0.0, deadline - time.time()))
+                        )
+                        continue
+                    return self._send(500, {"error": str(e), "done": int(lo)})
             if res is None:
                 return self._send(
                     504, {"error": "bulk chunk timed out", "done": int(lo)}

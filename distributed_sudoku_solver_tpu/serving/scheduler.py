@@ -72,6 +72,7 @@ from distributed_sudoku_solver_tpu.ops.frontier import (
     unpack_status,
 )
 from distributed_sudoku_solver_tpu.serving import engine as engine_mod
+from distributed_sudoku_solver_tpu.serving import faults
 
 # The resident frontier never retires, so the per-solve step budget is
 # replaced by wall-clock deadlines; int32 max keeps run_frontier's
@@ -243,6 +244,20 @@ class ResidentFlight:
         self._pending: deque = deque()  # FIFO admission queue
         self._lock = threading.Lock()
         self._closed = False
+        # Self-healing (serving/faults.py): a failed device program no
+        # longer closes admission forever.  Transient failures rebuild the
+        # flight after a cooldown with its jobs requeued; the breaker opens
+        # after k consecutive rebuild failures (admission then deflects to
+        # static flights) and half-opens after its own cooldown.  All time
+        # comes from the policy clock (injectable for sleep-free tests).
+        self.policy = engine.recovery
+        self.breaker = faults.CircuitBreaker(self.policy)
+        self._cooldown_until = 0.0
+        self.rebuilds = 0  # flights torn down and requeued for rebuild
+        self.rebuild_requeued = 0  # jobs put back on the admission queue
+        self.requeued_static = 0  # jobs rerouted to static flights
+        self.breaker_deflected = 0  # admissions deflected while open
+        self.closed_deflected = 0  # admissions deflected by a closed flight
         # Counters (occupancy/queue read under the lock; the rest are
         # single-writer on the device loop, readers tolerate staleness).
         self.admitted = 0
@@ -268,19 +283,42 @@ class ResidentFlight:
         #   event_wall)
 
     # -- any-thread surface --------------------------------------------------
-    def try_admit(self, job) -> bool:
-        """Queue ``job`` for attachment; False = saturated (or closed)."""
+    #: admit() verdicts.  SATURATED is the only one a reject-mode caller
+    #: may 429 on: the flight is healthy but full, so Retry-After is an
+    #: honest hint.  DEFLECTED (breaker open/half-denied, or permanently
+    #: closed) must fall back to static flights even under reject mode —
+    #: the resident flight being broken is not client backpressure.
+    ADMITTED = "admitted"
+    SATURATED = "saturated"
+    DEFLECTED = "deflected"
+
+    def admit(self, job) -> str:
+        """Queue ``job`` for attachment; returns an admission verdict."""
+        if not self.breaker.allow():
+            with self._lock:  # submit threads race here like admitted/rejected
+                self.breaker_deflected += 1
+            return self.DEFLECTED
         with self._lock:
             if self._closed:
-                return False
+                # Permanently closed (permanent fault / terminal fail()):
+                # counted apart from breaker deflections so /metrics shows
+                # WHY this geometry's traffic is bypassing the resident
+                # path — a closed flight never reopens, a breaker does.
+                self.closed_deflected += 1
+                return self.DEFLECTED
             if len(self._pending) >= self.rcfg.queue_depth:
                 self.rejected += 1
-                return False
+                return self.SATURATED
             if job.deadline is None:
                 job.deadline = time.monotonic() + self.rcfg.default_deadline_s
             self._pending.append(job)
             self.admitted += 1
-            return True
+            return self.ADMITTED
+
+    def try_admit(self, job) -> bool:
+        """Boolean convenience over :meth:`admit` (False = not admitted,
+        whatever the reason)."""
+        return self.admit(job) == self.ADMITTED
 
     def retry_after_s(self) -> float:
         """Backpressure hint: roughly how long until queue headroom opens —
@@ -294,7 +332,18 @@ class ResidentFlight:
             )
         return float(min(30.0, max(0.1, per_job * backlog / self.n_slots)))
 
+    def cooling(self) -> bool:
+        """Rebuild cooldown after a transient failure still running."""
+        return self.policy.clock() < self._cooldown_until
+
     def active(self) -> bool:
+        # A flight cooling down after a failure holds its requeued jobs
+        # but must not dispatch until the cooldown elapses — active() going
+        # False lets the engine loop fall back to its 50 ms queue poll (no
+        # busy-spin); the loop still step()s a cooling flight with queued
+        # jobs so cancels/deadlines are swept during the cooldown.
+        if self.cooling():
+            return False
         with self._lock:
             return bool(self._pending) or any(
                 s is not None for s in self.slots
@@ -339,6 +388,14 @@ class ResidentFlight:
                     "count": snap["count"],
                     **{k: round(snap[k] * 1e3, 3) for k in ("p50", "p95")},
                 }
+        out["faults"] = {
+            "rebuilds": int(self.rebuilds),
+            "rebuild_requeued": int(self.rebuild_requeued),
+            "requeued_static": int(self.requeued_static),
+            "breaker_deflected": int(self.breaker_deflected),
+            "closed_deflected": int(self.closed_deflected),
+            "breaker": self.breaker.metrics(),
+        }
         return out
 
     # -- device-loop surface -------------------------------------------------
@@ -352,7 +409,12 @@ class ResidentFlight:
         while the device crunches the chunk just enqueued.  Consequences
         of a chunk are therefore observed one chunk late — the same
         documented reaction lag as the static flight loop."""
+        # Queue housekeeping first, even mid-cooldown: a cancelled or
+        # deadline-expired job requeued on a cooling flight must resolve
+        # now, not after the (operator-settable) cooldown elapses.
         self._sweep_pending()
+        if self.cooling():
+            return  # rebuilding after a failure: no device work yet
         self._consume_status()
         t0 = time.monotonic()
         self._event_wall = 0.0
@@ -378,6 +440,10 @@ class ResidentFlight:
         self._status = unpack_status(raw, self.n_slots)
         self.chunk_wall.record(time.monotonic() - t0)
         self.chunks += 1
+        # A consumed chunk is the breaker's definition of success: it
+        # resets the consecutive-failure count and closes a half-open
+        # breaker (the probe rebuild proved the device serves again).
+        self.breaker.record_success()
 
     def _resolve_dead(self, job, cancelled: bool) -> None:
         """Resolve a job that leaves the scheduler with no verdict: either
@@ -496,6 +562,11 @@ class ResidentFlight:
                 self._resolve_dead(job, cancelled)
             else:
                 self.engine._finish_job(job)
+        if faults.active() is not None:  # don't build uuid tuples per round
+            faults.fire(
+                "resident.detach",
+                uuids=tuple(j.uuid for j in self.slots if j is not None),
+            )
         self.state = _detach_jit(self.state, jnp.asarray(detach_mask))
 
     def _attach_pending(self) -> None:
@@ -526,6 +597,10 @@ class ResidentFlight:
             batch.append((slot, job))
         if not batch:
             return
+        if faults.active() is not None:
+            faults.fire(
+                "resident.attach", uuids=tuple(job.uuid for _, job in batch)
+            )
         if self.state is None:
             self.state = _init_resident(self.geom, self.config, self.n_slots)
         n = self.geom.n
@@ -569,14 +644,75 @@ class ResidentFlight:
             from distributed_sudoku_solver_tpu.utils.checkpoint import (
                 advance_frontier_status as _advance_fn,
             )
+        if faults.active() is not None:
+            faults.fire(
+                "resident.advance",
+                uuids=tuple(j.uuid for j in self.slots if j is not None),
+            )
         self.state, self._pending_status = _advance_fn(
             self.state, jnp.int32(self.rcfg.chunk_steps), self.geom, self.config
         )
 
+    def on_failure(self, exc: BaseException) -> None:
+        """A device program died mid-round (attach/advance/status): recover
+        instead of erroring every held job (the pre-round-9 behavior, now
+        only the last resort).
+
+        The donated frontier did not survive the failed program, so all
+        device state is dropped; held jobs (slots AND admission queue) are
+        charged one retry each — those out of budget fail, survivors are
+        requeued.  A *transient* fault requeues them on this flight's own
+        admission queue and schedules a rebuild after ``rebuild_cooldown_s``
+        (a rebuilt flight re-attaches from job grids — sound, because no
+        partial results were ever reported).  A *permanent* fault, or a
+        circuit breaker driven OPEN by ``breaker_failures`` consecutive
+        rebuild failures, reroutes survivors to static flights instead
+        (they keep their deadlines, lose only the resident packing); a
+        permanent fault additionally closes admission for good — this
+        geometry's resident program is broken, not unlucky.
+        """
+        kind = faults.classify(exc)
+        label = f"{type(exc).__name__}: {exc}"
+        self.breaker.record_failure()
+        self.state = None
+        self._pending_status = None
+        self._status = None
+        held = [j for j in self.slots if j is not None]
+        self.slots = [None] * self.n_slots
+        with self._lock:
+            held.extend(self._pending)
+            self._pending.clear()
+            self._free = deque(range(self.n_slots))
+        survivors = [
+            job
+            for job in held
+            if not job.done.is_set()
+            and self.engine._charge_retry(job, kind, label)
+        ]
+        if kind == faults.PERMANENT or self.breaker.state == self.breaker.OPEN:
+            for job in survivors:
+                self.engine._requeue(job)
+            self.requeued_static += len(survivors)
+            if kind == faults.PERMANENT:
+                with self._lock:
+                    self._closed = True
+        else:
+            # Rebuild path: jobs go back to the front of the admission
+            # queue in order; the cooldown keeps back-to-back failure
+            # storms from monopolizing the device loop.
+            with self._lock:
+                self._pending.extendleft(reversed(survivors))
+            self.rebuild_requeued += len(survivors)
+            self.rebuilds += 1
+            self._cooldown_until = (
+                self.policy.clock() + self.policy.rebuild_cooldown_s
+            )
+
     def fail(self, exc: BaseException) -> None:
-        """A device program died (compile/OOM): fail every job this flight
+        """Terminal failure (no recovery): fail every job this flight
         holds and close admission — future submits fall back to static
-        flights, exactly like a failed static flight keeps the loop alive."""
+        flights.  Kept for callers that need the pre-round-9 semantics;
+        the engine loop itself now routes through :meth:`on_failure`."""
         self.drain(f"{type(exc).__name__}: {exc}")
 
     def drain(self, reason: str = "engine stopped") -> None:

@@ -427,6 +427,43 @@ def test_engine_stopped_solution_reexecutes_not_finalizes():
         a.engine.stop(timeout=1)
 
 
+def test_permanent_remote_error_finalizes_without_reexecution():
+    """Round-9 twin of the test above, from the other side of the fault
+    taxonomy (serving/faults.py): a SOLUTION carrying a PERMANENT error —
+    one a retry cannot cure — must finalize the client's job with that
+    error instead of burning a local re-execution that would fail
+    identically.  Transient errors (previous test) still re-execute."""
+    a = make_node()
+    try:
+        g = np.asarray(EASY_9, np.int32)
+        from distributed_sudoku_solver_tpu.cluster.node import Job as CJob
+
+        ju = f"{a.addr_s}/test-permanent-error"
+        handle = CJob(uuid=ju, grid=g, geom=a_geom(g))
+        with a._lock:
+            a._ledger[ju] = {
+                "grid": g, "member": "127.0.0.1:1", "job": handle,
+                "config": None,
+            }
+        a._track("127.0.0.1:1", +1)
+        a._on_solution(
+            {
+                "method": "SOLUTION", "uuid": ju, "solved": False,
+                "unsat": False, "cancelled": False, "nodes": 0,
+                "error": "ValueError: lanes must divide the mesh",
+                "solution": None,
+            }
+        )
+        assert handle.done.wait(30), "permanent error never finalized"
+        assert not handle.solved
+        assert handle.error and "ValueError" in handle.error
+        with a._lock:
+            assert ju not in a._ledger  # finalized, not re-queued
+    finally:
+        a.kill()
+        a.engine.stop(timeout=1)
+
+
 def a_geom(g):
     from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
 

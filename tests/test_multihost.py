@@ -12,11 +12,32 @@ import subprocess
 import sys
 import time
 
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from multimesh_script import free_port as _free_port  # noqa: E402
 
 
+# Capability gate for cross-process SPMD: the XLA:CPU PjRt client has no
+# multi-process runtime — a 2-process ``jax.distributed`` mesh fails inside
+# the child controllers with "Multiprocess computations aren't implemented
+# on the CPU backend" (pre-existing container limitation, CHANGES.md PR 3).
+# TPU (and GPU) clients implement it; the rest of this module's
+# control-plane tests ride plain TCP and stay on.  The gate mirrors
+# conftest's lane switch via the env var INSTEAD of asking jax (outside the
+# TPU lane conftest forces the CPU backend anyway, and calling
+# jax.default_backend() here would initialize the hardware backend in the
+# pytest parent at collection time — poisoning the very child controllers
+# the un-skipped test spawns).
+_TPU_LANE = os.environ.get("TPU_TESTS") == "1"
+
+
+@pytest.mark.skipif(
+    not _TPU_LANE,
+    reason="needs a backend with cross-process SPMD support (XLA:CPU PjRt "
+    "has no multi-process runtime: \"Multiprocess computations aren't "
+    "implemented\"); run the TPU lane (TPU_TESTS=1) to exercise this",
+)
 def test_cross_process_mesh(tmp_path):
     """VERDICT r2 #3: ONE device mesh spanning two OS processes.
 
