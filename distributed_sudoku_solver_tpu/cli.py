@@ -119,6 +119,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--sharded", action="store_true", help="shard lanes over all visible devices")
     ap.add_argument("--heartbeat-s", type=float, default=1.0)
     ap.add_argument(
+        "--part-deadline",
+        type=float,
+        default=0.0,
+        help="seconds before a shed subtree part stuck on a wedged-but-"
+        "alive peer is re-homed locally (0 = off: the failure detector "
+        "covers real deaths, and a deep search can legitimately run long; "
+        "see README 'Cluster failure semantics' for the false-death-vs-"
+        "duplicated-work tradeoff)",
+    )
+    ap.add_argument(
         "--profile-dir",
         type=str,
         default=None,
@@ -280,7 +290,10 @@ def main(argv=None) -> None:
             host=args.host,
             port=args.p2p_port,
             anchor=parse_addr(args.anchor) if args.anchor else None,
-            config=ClusterConfig(heartbeat_s=args.heartbeat_s),
+            config=ClusterConfig(
+                heartbeat_s=args.heartbeat_s,
+                part_deadline_s=args.part_deadline,
+            ),
             advertise_host=args.advertise_host,
         ).start()
         api = ApiServer(node, host=args.host, port=args.http_port, verbose=True).start()

@@ -40,6 +40,7 @@ Capability map (reference -> here):
 from __future__ import annotations
 
 import base64
+import collections
 import dataclasses
 import logging
 import socket
@@ -110,6 +111,19 @@ class ClusterConfig:
     fail_factor: float = 3.0  # declare dead after fail_factor * heartbeat_s
     io_timeout_s: float = 5.0
     stats_timeout_s: float = 2.0
+    # At-least-once delivery for result-bearing messages (SOLUTION /
+    # PART_RESULT): a failed send is re-attempted send_retries more times,
+    # retry_delay_s apart (on the node clock, so virtual in simnet tests).
+    # An ambiguous failure may already have been delivered, so receivers
+    # dedupe these methods by uuid/part — see _handle's dedupe ledger.
+    send_retries: int = 2
+    retry_delay_s: float = 0.25
+    # How long a coordinator keeps probing an evicted-but-possibly-alive
+    # member with its current view (the split-brain heal channel: a
+    # partitioned survivor learns the winning view and rejoins/demotes).
+    # Probes ride the per-beat broadcast; a really-dead member costs one
+    # failed connect per beat until the tombstone expires.
+    tombstone_probe_s: float = 60.0
     # Mid-job offload + progress checkpointing:
     needwork: bool = True  # idle nodes pull subtree work from the ring
     shed_k: int = 8  # max stack rows shipped per SUBTASK
@@ -121,6 +135,33 @@ class ClusterConfig:
     # peer; 0 disables (the failure detector covers actual deaths, and a
     # deep search can legitimately run long).
     part_deadline_s: float = 0.0
+
+
+class _DedupeLRU:
+    """Bounded seen-set for at-least-once delivery: result/work-bearing
+    messages (TASK, SUBTASK, SOLUTION, PART_RESULT) are deduped by their
+    uuid so an ambiguous-failure retry that was in fact delivered twice
+    executes once.  Bounded like the engine's stale-cancel ledger: a uuid
+    evicted after 4096 newer ones has long since resolved, and a duplicate
+    arriving later still hits the handlers' own idempotence (popped
+    ledger, done-part) — this ledger exists to stop duplicate *execution*,
+    not to be the only line of defense."""
+
+    def __init__(self, cap: int = 4096):
+        self._cap = cap
+        self._seen: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def seen(self, key) -> bool:
+        """True if ``key`` was recorded before; records it otherwise."""
+        with self._lock:
+            if key in self._seen:
+                self._seen.move_to_end(key)
+                return True
+            self._seen[key] = None
+            while len(self._seen) > self._cap:
+                self._seen.popitem(last=False)
+            return False
 
 
 class _Exec:
@@ -182,7 +223,7 @@ class _Exec:
                 "nodes": 0,
                 "rows": rows_packed,
                 "config": config,
-                "t0": time.monotonic(),
+                "t0": self.node._clock.now(),
                 "rehomed": False,
             }
             return True
@@ -195,7 +236,7 @@ class _Exec:
         that blew the deadline.  A false death verdict at worst duplicates
         the part's work — PART_RESULT first-wins dedupe keeps the aggregate
         sound."""
-        now = time.monotonic()
+        now = self.node._clock.now()
         out = []
         with self.lock:
             if self.finalized:
@@ -381,19 +422,30 @@ class ClusterNode:
         anchor: Optional[Addr] = None,
         config: ClusterConfig = ClusterConfig(),
         advertise_host: Optional[str] = None,
+        transport=None,
+        clock=None,
     ):
         """``host`` is the bind address; ``advertise_host`` is the identity
         other members dial (defaults to ``host``, which is only correct for
         single-machine clusters — multi-host deployments must advertise a
-        routable address, e.g. from :func:`local_ip`)."""
+        routable address, e.g. from :func:`local_ip`).
+
+        ``transport``/``clock`` are the injectable network/time seam (the
+        contract in ``cluster/wire.py``'s module note): real sockets and
+        ``time.monotonic``/``time.sleep`` by default — zero production
+        behavior change — or a ``cluster/simnet.py`` plane, which runs the
+        identical protocol over an in-memory network with a virtual clock
+        so partitions, duplicate delivery, reordering, and split-brain
+        heal are deterministic, socket-free tests."""
         self.engine = engine
         self.config = config
-        self._listener = socket.create_server((host, port))
-        bound_port = self._listener.getsockname()[1]
-        adv = advertise_host or host
+        self._clock = clock or wire.SystemClock()
+        self._transport = transport or wire.TcpTransport()
+        bound = self._transport.bind(host, port)
+        adv = advertise_host or bound[0]
         if adv in ("0.0.0.0", "::"):
             adv = local_ip()
-        self.addr: Addr = (adv, bound_port)
+        self.addr: Addr = (adv, bound[1])
         self.addr_s = addr_str(self.addr)
         self.anchor = anchor
 
@@ -411,7 +463,7 @@ class ClusterNode:
         # (``/root/reference/DHT_Node.py:332-336``).
         self.net_term: int = 0
         self.net_epoch: int = 0
-        self._last_hb = time.monotonic()
+        self._last_hb = self._clock.now()
         self._ledger: dict[str, dict] = {}  # uuid -> {grid, member, job, rows?, nodes_done?}
         self._execs: dict[str, _Exec] = {}  # uuid -> live local execution
         self._parts: dict[str, str] = {}  # part_uuid -> root uuid (parts run here)
@@ -429,15 +481,36 @@ class ClusterNode:
         # at all (no snapshot surface): counted so an operator can see how
         # much of the fleet's work resumes from the root on a death.
         self.progress_resident = 0
+        # At-least-once / split-brain machinery (round 10): the dedupe
+        # ledger for result/work-bearing duplicates, the coordinator's
+        # tombstones of suspected-dead members (probed with the current
+        # view so a partitioned survivor can rejoin), per-peer
+        # rate-limiting of stale-view reflections, and the fault counters
+        # exported on /metrics (cluster.faults).
+        self._dedupe = _DedupeLRU()
+        self._evicted: dict[str, float] = {}  # member -> eviction time
+        self._reflect_at: dict[str, float] = {}  # peer -> next reflect time
+        self.duplicates_dropped: dict[str, int] = {}  # method -> count
+        self.stale_views_rejected = 0
+        self.stale_view_reflections = 0
+        self.partitions_healed = 0
+        self.demotions = 0
+        self.rehomed_parts = 0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ClusterNode":
-        for target, name in ((self._accept_loop, "accept"), (self._hb_loop, "hb")):
-            t = threading.Thread(target=target, daemon=True, name=f"{name}@{self.addr_s}")
-            t.start()
-            self._threads.append(t)
+        self._transport.serve(
+            self._handle,
+            on_error=self._log_bad_message,
+            io_timeout=self.config.io_timeout_s,
+        )
+        t = threading.Thread(
+            target=self._hb_loop, daemon=True, name=f"hb@{self.addr_s}"
+        )
+        t.start()
+        self._threads.append(t)
         if self.anchor is not None:
             self._send(self.anchor, {"method": "JOIN_REQ", "addr": self.addr_s})
         return self
@@ -448,14 +521,17 @@ class ClusterNode:
         if graceful and self.coordinator != self.addr_s:
             try:
                 self._send(
-                    self.coordinator, {"method": "LEAVE", "addr": self.addr_s}
+                    self.coordinator,
+                    {
+                        "method": "LEAVE",
+                        "addr": self.addr_s,
+                        "term": self.net_term,
+                        "epoch": self.net_epoch,
+                    },
                 )
             except WireError:
                 pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        self._transport.close()
 
     def kill(self) -> None:
         """Abrupt death for fault-injection tests: no LEAVE, just silence."""
@@ -496,55 +572,104 @@ class ClusterNode:
             except faults.SimulatedFault as e:
                 raise WireError(f"injected send fault: {e}") from e
         addr = peer if isinstance(peer, tuple) else wire.parse_addr(peer)
-        wire.send_msg(addr, payload, self.config.io_timeout_s)
+        self._transport.send(addr, payload, self.config.io_timeout_s)
+
+    def _send_result(self, peer, payload: dict) -> bool:
+        """At-least-once delivery for result-bearing messages (SOLUTION,
+        PART_RESULT): a failed send is retried under a small bounded budget
+        — an *ambiguous* failure (bytes written, then reset: see
+        ``WireError.ambiguous_delivery``) may already have been delivered,
+        so the receiver dedupes these methods by uuid (``_handle``); a
+        lost-for-sure failure (connect refused/timed out) retries are what
+        carry a result through a transient link fault at all.  Returns
+        False when every attempt failed: the peer is presumed dead and the
+        membership repair path (ledger re-execution, part re-homing) owns
+        the work from here."""
+        last: Optional[WireError] = None
+        for attempt in range(self.config.send_retries + 1):
+            if attempt:
+                self._clock.sleep(self.config.retry_delay_s)
+                if self._stop.is_set():
+                    return False
+            try:
+                self._send(peer, payload)
+                return True
+            except WireError as e:
+                last = e
+        if not self._stop.is_set():
+            _LOG.warning(
+                "[%s] %s to %s undeliverable after %d attempts: %r",
+                self.addr_s, payload.get("method"), peer,
+                self.config.send_retries + 1, last,
+            )
+        return False
+
+    def _log_bad_message(self, e: BaseException) -> None:
+        """Transport's handler-error sink: malformed or interrupted control
+        traffic is logged-and-dropped (RuntimeError covers "engine stopped"
+        during teardown; the catch is any Exception — arbitrary network
+        input must never kill a serving thread or wedge a loop);
+        reliability comes from sender-side errors, not server retries."""
+        if not self._stop.is_set():
+            _LOG.error(
+                "[%s] bad message: %r [%s]", self.addr_s, e, faults.classify(e)
+            )
 
     # -- background loops ----------------------------------------------------
-    def _accept_loop(self) -> None:
-        self._listener.listen()
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
-            ).start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        with conn:
-            try:
-                conn.settimeout(self.config.io_timeout_s)
-                msg = wire.recv_msg(conn)
-                self._handle(msg, conn)
-            except (WireError, OSError, ValueError, KeyError, RuntimeError) as e:
-                # Malformed or interrupted control traffic is logged-and-dropped
-                # (RuntimeError covers "engine stopped" during teardown);
-                # reliability comes from sender-side errors, not server retries.
-                if not self._stop.is_set():
-                    _LOG.error(
-                        "[%s] bad message: %r [%s]",
-                        self.addr_s, e, faults.classify(e),
-                    )
-
     def _hb_loop(self) -> None:
         while not self._stop.is_set():
-            time.sleep(self.config.heartbeat_s)
+            self._clock.sleep(self.config.heartbeat_s)
+            if self._stop.is_set():
+                return
+            with self._lock:
+                is_coord = self.coordinator == self.addr_s
+                have_tombstones = bool(self._evicted)
+                orphaned = (
+                    not is_coord and self.addr_s not in self.network
+                    and len(self.network) > 0
+                )
+                coord = self.coordinator
+                term, epoch = self.net_term, self.net_epoch
             # Coordinator re-broadcasts the view every beat: a member that
             # missed an UPDATE_NETWORK (send failure is fire-and-forget)
             # converges on the next beat instead of never.  Off-thread, so a
             # partitioned member's connect timeout cannot delay our own
-            # heartbeats past the failure threshold.
-            if self.coordinator == self.addr_s and len(self.network) > 1:
+            # heartbeats past the failure threshold.  Tombstoned (evicted
+            # but possibly alive) members are probed with the same payload
+            # — the split-brain heal channel — so the broadcast also runs
+            # when the view has shrunk to just us.
+            if is_coord and (have_tombstones or len(self.network) > 1):
                 threading.Thread(
                     target=self._broadcast_network, daemon=True
                 ).start()
+            if orphaned:
+                # Evicted from the view (false death / lost partition) and
+                # the immediate rejoin in _on_update_network was lost:
+                # retry every beat until a view contains us again.
+                try:
+                    self._send(coord, {"method": "JOIN_REQ", "addr": self.addr_s})
+                except WireError:
+                    pass
+            # Deadline-based part re-homing must tick even for a solo node
+            # (ring of one): it recovers work from wedged-but-alive peers
+            # that are no longer in the view at all.
+            if self.config.part_deadline_s > 0:
+                self._recover_parts()
             pred, succ = self._ring()
             if succ is None:
                 with self._lock:
-                    self._last_hb = time.monotonic()
+                    self._last_hb = self._clock.now()
                 continue
             try:
-                self._send(succ, {"method": "HEARTBEAT", "from": self.addr_s})
+                self._send(
+                    succ,
+                    {
+                        "method": "HEARTBEAT",
+                        "from": self.addr_s,
+                        "term": term,
+                        "epoch": epoch,
+                    },
+                )
             except WireError:
                 pass  # successor's own detector handles its death
             # Receiver-initiated stealing (``DHT_Node.py:246-248``): idle ->
@@ -556,31 +681,63 @@ class ClusterNode:
                     pass
             limit = self.config.heartbeat_s * self.config.fail_factor
             with self._lock:
-                expired = time.monotonic() - self._last_hb > limit
+                expired = self._clock.now() - self._last_hb > limit
             if expired and pred is not None:
                 self._on_peer_dead(pred)
-            if self.config.part_deadline_s > 0:
-                self._recover_parts()
 
     # -- message handling ----------------------------------------------------
-    def _handle(self, msg: dict, conn: socket.socket) -> None:
+
+    # Result/work-bearing one-shot methods and the field that identifies
+    # the unit of work: duplicates (at-least-once redelivery) are dropped
+    # here, before any handler runs, so a re-dispatch whose first copy DID
+    # arrive executes once (duplicates_dropped counts the drops).
+    _DEDUPE_KEYS = {
+        "TASK": "uuid",
+        "SOLUTION": "uuid",
+        "SUBTASK": "part",
+        "PART_RESULT": "part",
+    }
+
+    @staticmethod
+    def _addr_field(msg: dict, key: str) -> str:
+        """Validated member-address field: membership handlers must never
+        install non-address garbage into the view (a fuzzer's int joiner
+        would poison ring math and every later dial)."""
+        v = msg.get(key)
+        if not isinstance(v, str) or ":" not in v:
+            raise WireError(f"malformed {key!r} field: {v!r}")
+        return v
+
+    def _handle(self, msg: dict) -> Optional[dict]:
+        """Dispatch one inbound message; returns the reply dict for
+        request/reply methods (STATS_REQ), else None.  Raises on malformed
+        input — the transport routes that to _log_bad_message."""
         method = msg["method"]
+        dkey = self._DEDUPE_KEYS.get(method)
+        if dkey is not None:
+            uid = msg.get(dkey)
+            if uid is not None and self._dedupe.seen((method, str(uid))):
+                self._count_duplicate(method)
+                return None
         if method == "JOIN_REQ":
-            self._on_join_req(msg["addr"])
+            self._on_join_req(self._addr_field(msg, "addr"))
         elif method == "UPDATE_NETWORK":
-            self._on_update_network(
-                list(msg["network"]),
-                msg["coordinator"],
-                int(msg["term"]),
-                int(msg["epoch"]),
-            )
+            self._on_update_network(msg)
         elif method == "HEARTBEAT":
-            with self._lock:
-                self._last_hb = time.monotonic()
+            self._on_heartbeat(msg)
         elif method == "NODE_FAILED":
-            self._on_node_failed(msg["addr"])
+            self._on_node_failed(
+                self._addr_field(msg, "addr"),
+                reporter_term=msg.get("term"),
+                method="NODE_FAILED",
+            )
         elif method == "LEAVE":
-            self._on_node_failed(msg["addr"])  # same repair path, no suspicion
+            # Same repair path, no suspicion (the member *chose* to go, so
+            # no tombstone probing either) — and a leaver's intent is honored
+            # whatever view version it held, unlike a failure *verdict*.
+            self._on_node_failed(
+                self._addr_field(msg, "addr"), suspected=False, method="LEAVE"
+            )
         elif method == "TASK":
             self._on_task(msg)
         elif method == "SOLUTION":
@@ -588,7 +745,7 @@ class ClusterNode:
         elif method == "CANCEL":
             self._on_cancel(msg["uuid"])
         elif method == "NEEDWORK":
-            self._on_needwork(msg["addr"])
+            self._on_needwork(self._addr_field(msg, "addr"))
         elif method == "SUBTASK":
             self._on_subtask(msg)
         elif method == "PART_RESULT":
@@ -597,20 +754,46 @@ class ClusterNode:
             self._on_progress(msg)
         elif method == "STATS_REQ":
             s = self.engine.stats()
-            wire.reply_msg(
-                conn,
-                {
-                    "method": "STATS_RES",
-                    "address": self.addr_s,
-                    "validations": s["validations"],
-                    "solved": s["solved"],
-                },
-            )
+            return {
+                "method": "STATS_RES",
+                "address": self.addr_s,
+                "validations": s["validations"],
+                "solved": s["solved"],
+            }
         else:
             _LOG.warning("[%s] unknown method %r", self.addr_s, method)
+        return None
+
+    def _count_duplicate(self, method: str) -> None:
+        with self._lock:
+            self.duplicates_dropped[method] = (
+                self.duplicates_dropped.get(method, 0) + 1
+            )
+        _LOG.info("[%s] duplicate %s dropped", self.addr_s, method)
+
+    def _on_heartbeat(self, msg: dict) -> None:
+        """A heartbeat refreshes the failure detector — unless its sender
+        holds a strictly older *term*: a pre-partition coordinator's ring
+        mate must not suppress detection in the healed, promoted ring.  The
+        stale sender gets our view reflected back (rate-limited) so it can
+        demote/rejoin — membership-bearing messages all carry the
+        (term, epoch) guard now, not just UPDATE_NETWORK."""
+        term = msg.get("term")
+        sender = msg.get("from")
+        reflect_to = None
+        with self._lock:
+            if term is not None and int(term) < self.net_term:
+                self.stale_views_rejected += 1
+                if isinstance(sender, str) and ":" in sender:
+                    reflect_to = self._reflect_ok_locked(sender)
+            else:
+                self._last_hb = self._clock.now()
+        if reflect_to:
+            self._reflect_view(reflect_to)
 
     # -- membership ----------------------------------------------------------
     def _broadcast_network(self) -> None:
+        now = self._clock.now()
         with self._lock:
             members = list(self.network)
             payload = {
@@ -619,8 +802,22 @@ class ClusterNode:
                 "coordinator": self.coordinator,
                 "term": self.net_term,
                 "epoch": self.net_epoch,
+                "from": self.addr_s,
             }
-        for m in members:
+            # Tombstone probes: keep offering the winning view to members
+            # we evicted on suspicion — a false-death or partition survivor
+            # rejoins (or, if it is a rival coordinator with a HIGHER view,
+            # rejects this as stale and reflects its view back, which
+            # demotes us).  Expired tombstones stop being dialed.
+            expired = [
+                m
+                for m, t in self._evicted.items()
+                if now - t > self.config.tombstone_probe_s
+            ]
+            for m in expired:
+                del self._evicted[m]
+            probes = [m for m in self._evicted if m not in members]
+        for m in members + probes:
             if m != self.addr_s:
                 try:
                     self._send(m, payload)
@@ -632,30 +829,96 @@ class ClusterNode:
             self._send(self.coordinator, {"method": "JOIN_REQ", "addr": joiner})
             return
         with self._lock:
-            if joiner not in self.network:
+            healed = self._evicted.pop(joiner, None) is not None
+            if healed:
+                # An evicted-but-alive member came back through the winner:
+                # the observable end of a partition (or false death).
+                self.partitions_healed += 1
+            duplicate = joiner in self.network
+            if not duplicate:
                 self.network.append(joiner)
                 self.net_epoch += 1
-            self._last_hb = time.monotonic()
+            self._last_hb = self._clock.now()
+        if duplicate:
+            # Idempotent replay: no epoch bump, no broadcast storm — the
+            # per-beat view re-broadcast covers a joiner that missed ours.
+            self._count_duplicate("JOIN_REQ")
+            return
         self._broadcast_network()
 
-    def _on_update_network(
-        self, network: list[str], coordinator: str, term: int, epoch: int
-    ) -> None:
+    def _on_update_network(self, msg: dict) -> None:
+        raw = msg["network"]
+        if not isinstance(raw, list) or not all(
+            isinstance(m, str) and ":" in m for m in raw
+        ):
+            raise WireError(f"malformed network field: {raw!r}")
+        network = list(raw)
+        coordinator = self._addr_field(msg, "coordinator")
+        term, epoch = int(msg["term"]), int(msg["epoch"])
+        sender = msg.get("from")
         rejoin = False
+        reflect_to = None
+        gone: list = []
         with self._lock:
             if (term, epoch) <= (self.net_term, self.net_epoch):
-                return  # stale or duplicate view; ours is at least as new
-            self.network = network
-            self.coordinator = coordinator
-            self.net_term = term
-            self.net_epoch = epoch
-            self._last_hb = time.monotonic()
-            # Evicted by a false death verdict (e.g. my heartbeats starved):
-            # re-join through the coordinator rather than orbiting alone.
-            rejoin = self.addr_s not in network and not self._stop.is_set()
-            gone = [
-                u for u, e in self._ledger.items() if e["member"] not in network
-            ]
+                # Stale or duplicate view; ours is at least as new.  An
+                # *equal* version is the steady-state per-beat re-broadcast;
+                # a strictly older one is rejected loudly — and when it
+                # comes from a rival coordinator (split-brain survivor
+                # still broadcasting its losing view), our view is
+                # reflected back so the loser can demote and rejoin.
+                if (term, epoch) < (self.net_term, self.net_epoch):
+                    self.stale_views_rejected += 1
+                    if (
+                        coordinator != self.coordinator
+                        and isinstance(sender, str)
+                        and ":" in sender
+                    ):
+                        reflect_to = self._reflect_ok_locked(sender)
+                if reflect_to is None:
+                    return
+            else:
+                if (
+                    self.coordinator == self.addr_s
+                    and coordinator != self.addr_s
+                    and (self.net_term, self.net_epoch) > (0, 0)
+                ):
+                    # Split-brain resolution, losing side: someone holds a
+                    # provably newer view in which we are not coordinator.
+                    # Install it, stand down, and (below) rejoin if evicted
+                    # — our in-flight work re-homes through the ordinary
+                    # orphan paths against the new view.  A fresh node
+                    # installing its anchor's first view is NOT a demotion
+                    # (it was only ever coordinator of itself: (0,0) —
+                    # a node that has issued no membership change).
+                    self.demotions += 1
+                    _LOG.warning(
+                        "[%s] demoted: installing view (%d,%d) from %s "
+                        "(ours was (%d,%d))",
+                        self.addr_s, term, epoch, coordinator,
+                        self.net_term, self.net_epoch,
+                    )
+                    self._evicted.clear()  # no longer the membership authority
+                self.network = network
+                self.coordinator = coordinator
+                self.net_term = term
+                self.net_epoch = epoch
+                self._last_hb = self._clock.now()
+                # Evicted by a false death verdict (e.g. my heartbeats
+                # starved): re-join through the coordinator rather than
+                # orbiting alone.
+                rejoin = self.addr_s not in network and not self._stop.is_set()
+                # Only an INSTALLED view may drive re-execution — a ledger
+                # scan against a rejected stale list would re-run jobs
+                # whose members are perfectly alive in ours.
+                gone = [
+                    u
+                    for u, e in self._ledger.items()
+                    if e["member"] not in network
+                ]
+        if reflect_to:
+            self._reflect_view(reflect_to)
+            return
         for u in gone:
             self._reexecute(u)
         self._recover_parts()
@@ -665,15 +928,69 @@ class ClusterNode:
                     coordinator, {"method": "JOIN_REQ", "addr": self.addr_s}
                 )
             except WireError:
-                pass
+                pass  # retried every beat by _hb_loop while orphaned
 
-    def _on_node_failed(self, dead: str) -> None:
+    def _reflect_ok_locked(self, peer: str) -> Optional[str]:
+        """Rate-limit stale-view reflections to one per peer per heartbeat
+        (caller holds the lock); returns the peer when a reflection is due."""
+        now = self._clock.now()
+        if now < self._reflect_at.get(peer, 0.0):
+            return None
+        self._reflect_at[peer] = now + self.config.heartbeat_s
+        self.stale_view_reflections += 1
+        return peer
+
+    def _reflect_view(self, peer: str) -> None:
+        """Send our (newer) view to a peer that just asserted an older one —
+        the anti-entropy half of split-brain healing."""
+        with self._lock:
+            payload = {
+                "method": "UPDATE_NETWORK",
+                "network": list(self.network),
+                "coordinator": self.coordinator,
+                "term": self.net_term,
+                "epoch": self.net_epoch,
+                "from": self.addr_s,
+            }
+        try:
+            self._send(peer, payload)
+        except WireError:
+            pass
+
+    def _on_node_failed(
+        self,
+        dead: str,
+        suspected: bool = True,
+        method: str = "NODE_FAILED",
+        reporter_term=None,
+    ) -> None:
+        if dead == self.addr_s:
+            # A frame naming US dead (forged, or a detector whose view is
+            # hopelessly behind) must not make the node evict itself from
+            # its own view; if the rest of the ring really thinks we died,
+            # their next UPDATE_NETWORK triggers the rejoin path instead.
+            _LOG.warning("[%s] ignoring %s naming this node", self.addr_s, method)
+            return
         if self.coordinator == self.addr_s:
             with self._lock:
-                if dead in self.network:
-                    self.network.remove(dead)
-                    self.net_epoch += 1
-                self._last_hb = time.monotonic()
+                if reporter_term is not None and int(reporter_term) < self.net_term:
+                    # A death verdict formed under a superseded term: the
+                    # reporter is behind a promotion (possibly ours); its
+                    # suspicion predates the current ring and is void.
+                    self.stale_views_rejected += 1
+                    return
+                if dead not in self.network:
+                    # Already removed (duplicate report, replayed LEAVE):
+                    # idempotent — no epoch bump, no broadcast storm.
+                    self._count_duplicate_locked(method)
+                    return
+                self.network.remove(dead)
+                self.net_epoch += 1
+                if suspected:
+                    # Keep probing: the "death" may be a partition, and the
+                    # probe is how the survivor finds its way back.
+                    self._evicted[dead] = self._clock.now()
+                self._last_hb = self._clock.now()
                 gone = [
                     u
                     for u, e in self._ledger.items()
@@ -686,10 +1003,21 @@ class ClusterNode:
         else:
             try:
                 self._send(
-                    self.coordinator, {"method": "NODE_FAILED", "addr": dead}
+                    self.coordinator,
+                    {
+                        "method": "NODE_FAILED",
+                        "addr": dead,
+                        "term": self.net_term,
+                        "epoch": self.net_epoch,
+                    },
                 )
             except WireError:
                 pass
+
+    def _count_duplicate_locked(self, method: str) -> None:
+        self.duplicates_dropped[method] = (
+            self.duplicates_dropped.get(method, 0) + 1
+        )
 
     def _on_peer_dead(self, dead: str) -> None:
         """My predecessor went silent (``check_neighbor`` analog, :158-209)."""
@@ -702,7 +1030,7 @@ class ClusterNode:
                 # the dead coordinator issued, including epochs we missed.
                 self.coordinator = self.addr_s
                 self.net_term += 1
-            self._last_hb = time.monotonic()
+            self._last_hb = self._clock.now()
         self._on_node_failed(dead)
 
     # -- local execution (engine + shed parts) -------------------------------
@@ -964,10 +1292,10 @@ class ClusterNode:
                 if r["solution"] is not None
                 else None,
             }
-            try:
-                self._send(origin, payload)
-            except WireError:
-                pass  # origin died; its successor's repair already re-executed
+            # At-least-once: retried on link faults (the origin dedupes by
+            # uuid); if every attempt fails the origin died and its
+            # successor's repair already re-executed the job.
+            self._send_result(origin, payload)
 
         ex = self._start_exec(
             fin, grid=grid, job_uuid=ju, config=_config_from_dict(msg.get("config"))
@@ -984,7 +1312,7 @@ class ClusterNode:
         """Stream the job's surviving subtree roots to its origin so a death
         here resumes mid-subtree there (SURVEY.md §5.4's promise)."""
         while not self._stop.is_set() and not ex.finalized:
-            time.sleep(self.config.progress_interval_s)
+            self._clock.sleep(self.config.progress_interval_s)
             if ex.finalized:
                 return
             if self.engine.job_is_resident(ex.uuid):
@@ -1037,7 +1365,9 @@ class ClusterNode:
                     },
                 )
             except WireError:
-                return  # origin unreachable; repair will reassign anyway
+                continue  # transient link fault or origin death: keep trying
+                # each interval — a PROGRESS is a pure refinement, and if the
+                # origin really died the repair path reassigns regardless.
 
     def _on_progress(self, msg: dict) -> None:
         with self._lock:
@@ -1113,10 +1443,10 @@ class ClusterNode:
                 payload["local"] = True
                 self._on_part_result(payload)
                 return
-            try:
-                self._send(report_to, payload)
-            except WireError:
-                pass  # shedder died; the origin's repair path re-covers this
+            # At-least-once: retried on link faults (the shedder dedupes by
+            # part uuid); if every attempt fails the shedder died and the
+            # origin's repair path re-covers the subtree.
+            self._send_result(report_to, payload)
 
         self._start_exec(
             fin,
@@ -1168,6 +1498,9 @@ class ClusterNode:
                     "[%s] part re-entry failed: %r [%s]",
                     self.addr_s, e, faults.classify(e),
                 )
+        else:
+            with self._lock:
+                self.rehomed_parts += 1
 
     def _on_part_result(self, msg: dict) -> None:
         with self._lock:
@@ -1239,7 +1572,7 @@ class ClusterNode:
 
         def ask(i: int, m: str) -> None:
             try:
-                results[i] = wire.request(
+                results[i] = self._transport.request(
                     wire.parse_addr(m),
                     {"method": "STATS_REQ"},
                     self.config.stats_timeout_s,
@@ -1291,6 +1624,25 @@ class ClusterNode:
                 # progress streaming; slot occupancy / admission waits /
                 # rejects ride the engine body's "resident" section.
                 "progress_resident": self.progress_resident,
+                # The cluster fault plane (round 10): what at-least-once
+                # delivery and membership versioning actually absorbed.
+                # duplicates_dropped — per-method redeliveries executed 0
+                # extra times; stale_views_rejected — membership assertions
+                # from superseded (term, epoch) views; stale_view_
+                # reflections — anti-entropy replies that teach a
+                # split-brain loser the winning view; partitions_healed —
+                # evicted-but-alive members re-admitted (coordinator side);
+                # demotions — rival coordinators that stood down (loser
+                # side); rehomed_parts — shed parts re-entered locally
+                # after executor death/deadline.
+                "faults": {
+                    "duplicates_dropped": dict(self.duplicates_dropped),
+                    "stale_views_rejected": self.stale_views_rejected,
+                    "stale_view_reflections": self.stale_view_reflections,
+                    "partitions_healed": self.partitions_healed,
+                    "demotions": self.demotions,
+                    "rehomed_parts": self.rehomed_parts,
+                },
             }
         return body
 

@@ -14,14 +14,33 @@ Connection discipline is datagram-style on purpose: one connection per
 message (optionally one reply on the same connection), so there is no
 session state to repair after a peer dies — matching the reference's
 fire-and-forget model with reliability added.
+
+"Raises at the sender" has two flavors, and retry logic must tell them
+apart (:attr:`WireError.ambiguous_delivery`): a failure *before* any frame
+byte reached the peer (connect refused / connect timeout) proves the
+message was not delivered, so a re-dispatch cannot duplicate it; a failure
+*after* bytes were written (reset mid-``sendall``, reply timeout) proves
+nothing — the peer may have received and acted on the whole frame, so any
+re-dispatch is at-least-once delivery and the receiver must be idempotent
+(``cluster/node.py`` dedupes result-bearing messages by uuid).
+
+This module also hosts the *production* transport/clock pair behind
+``ClusterNode``'s injectable seam (:class:`TcpTransport` /
+:class:`SystemClock`); the deterministic in-memory twin lives in
+``cluster/simnet.py``.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import struct
-from typing import Tuple
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+_LOG = logging.getLogger(__name__)
 
 Addr = Tuple[str, int]
 
@@ -30,7 +49,17 @@ _LEN = struct.Struct(">I")
 
 
 class WireError(Exception):
-    """Transport-level failure: peer unreachable, bad frame, oversize."""
+    """Transport-level failure: peer unreachable, bad frame, oversize.
+
+    ``ambiguous_delivery`` is the retry-relevant distinction (module
+    docstring): ``False`` — the message definitely did not reach the peer;
+    ``True`` — bytes were written before the failure, so the peer *may*
+    have processed the message and a re-dispatch implies duplicates.
+    """
+
+    def __init__(self, message: str, ambiguous_delivery: bool = False):
+        super().__init__(message)
+        self.ambiguous_delivery = ambiguous_delivery
 
 
 def addr_str(addr: Addr) -> str:
@@ -76,18 +105,161 @@ def reply_msg(sock: socket.socket, msg: dict) -> None:
 def send_msg(addr: Addr, msg: dict, timeout: float = 5.0) -> None:
     """Fire-and-forget (but reliable): deliver one message, no reply."""
     try:
-        with socket.create_connection(addr, timeout=timeout) as sock:
-            _send_frame(sock, msg)
+        sock = socket.create_connection(addr, timeout=timeout)
     except OSError as e:
-        raise WireError(f"send to {addr_str(addr)} failed: {e}") from e
+        raise WireError(f"connect to {addr_str(addr)} failed: {e}") from e
+    try:
+        with sock:
+            _send_frame(sock, msg)
+    except WireError:
+        raise  # oversize: refused before any byte was written
+    except OSError as e:
+        # The connection existed (sendall failure, or a close()-time reset
+        # surfacing on `with` exit): some — possibly all — frame bytes may
+        # have reached the peer before the failure.
+        raise WireError(
+            f"send to {addr_str(addr)} failed after connect: {e}",
+            ambiguous_delivery=True,
+        ) from e
 
 
 def request(addr: Addr, msg: dict, timeout: float = 5.0) -> dict:
     """Send one message and wait for one reply frame on the same connection."""
     try:
-        with socket.create_connection(addr, timeout=timeout) as sock:
-            sock.settimeout(timeout)
-            _send_frame(sock, msg)
-            return recv_msg(sock)
+        sock = socket.create_connection(addr, timeout=timeout)
     except OSError as e:
         raise WireError(f"request to {addr_str(addr)} failed: {e}") from e
+    try:
+        with sock:
+            sock.settimeout(timeout)
+            try:
+                _send_frame(sock, msg)
+            except OSError as e:
+                raise WireError(
+                    f"request to {addr_str(addr)} failed mid-send: {e}",
+                    ambiguous_delivery=True,
+                ) from e
+            try:
+                return recv_msg(sock)
+            except (WireError, OSError) as e:
+                # The request went out whole; only the reply failed — the
+                # peer may well have processed it.
+                raise WireError(
+                    f"request to {addr_str(addr)} failed awaiting reply: {e}",
+                    ambiguous_delivery=True,
+                ) from e
+    except WireError:
+        raise
+    except OSError as e:
+        # close()-time failure on `with` exit, after the request was sent.
+        raise WireError(
+            f"request to {addr_str(addr)} failed at close: {e}",
+            ambiguous_delivery=True,
+        ) from e
+
+
+# -- the production transport/clock pair --------------------------------------
+#
+# ClusterNode takes an injectable (transport, clock): these are the real
+# ones (sockets + time.monotonic/time.sleep), with zero behavior change
+# from the pre-seam node.  The transport contract, duck-typed and shared
+# with cluster/simnet.py:
+#
+#   bind(host, port) -> Addr          allocate the listening address
+#   serve(handler, on_error=None, io_timeout=5.0)
+#                                     start delivering inbound messages;
+#                                     handler(msg) returns an optional
+#                                     reply dict (request/reply methods);
+#                                     handler exceptions go to on_error
+#   close()                           stop serving (idempotent)
+#   send(addr, msg, timeout)          one message, no reply; raises WireError
+#   request(addr, msg, timeout) -> dict
+
+
+class SystemClock:
+    """Production clock: real monotonic time, real sleeps.  Late-bound on
+    purpose: the simnet purity guard (tests/conftest.py) monkeypatches
+    ``time.sleep``, and a class-level ``sleep = time.sleep`` captured at
+    import would let a simnet test that forgot ``clock=net.clock`` sleep
+    real wall-clock seconds without the guard ever noticing."""
+
+    @staticmethod
+    def now() -> float:
+        return time.monotonic()
+
+    @staticmethod
+    def sleep(dt: float) -> None:
+        time.sleep(dt)
+
+
+class TcpTransport:
+    """Production transport: one listener, one thread per connection — the
+    exact socket behavior ClusterNode always had, factored behind the
+    transport seam so the simulated plane can replace it."""
+
+    def __init__(self):
+        self._listener: Optional[socket.socket] = None
+        self._closed = threading.Event()
+        self._handler: Optional[Callable[[dict], Optional[dict]]] = None
+        self._on_error: Optional[Callable[[BaseException], None]] = None
+        self._io_timeout = 5.0
+
+    def bind(self, host: str, port: int) -> Addr:
+        self._listener = socket.create_server((host, port))
+        return (host, self._listener.getsockname()[1])
+
+    def serve(
+        self,
+        handler: Callable[[dict], Optional[dict]],
+        on_error: Optional[Callable[[BaseException], None]] = None,
+        io_timeout: float = 5.0,
+    ) -> None:
+        if self._listener is None:
+            raise RuntimeError("serve() before bind()")
+        self._handler = handler
+        self._on_error = on_error
+        self._io_timeout = io_timeout
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="wire-accept"
+        ).start()
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                conn.settimeout(self._io_timeout)
+                msg = recv_msg(conn)
+                reply = self._handler(msg)
+                if reply is not None:
+                    reply_msg(conn, reply)
+            except Exception as e:  # noqa: BLE001 - network input must never
+                # kill the serving thread; reliability comes from sender-side
+                # errors + retries, not server-side recovery.
+                if not self._closed.is_set():
+                    if self._on_error is not None:
+                        self._on_error(e)
+                    else:
+                        _LOG.error("bad message: %r", e)
+
+    def send(self, addr: Addr, msg: dict, timeout: float) -> None:
+        send_msg(addr, msg, timeout)
+
+    def request(self, addr: Addr, msg: dict, timeout: float) -> dict:
+        return request(addr, msg, timeout)

@@ -58,8 +58,16 @@ from typing import Callable, Iterable, Optional
 TRANSIENT = "transient"
 PERMANENT = "permanent"
 
-#: Injectable fault kinds and the wire-style status each simulates.
-FAULT_KINDS = ("oom", "preempt", "runtime", "delay", "permanent")
+#: Injectable fault kinds and the wire-style status each simulates.  The
+#: last two are *network link* kinds, consumed by ``cluster/simnet.py``'s
+#: per-link schedules rather than the serving dispatch seams: ``drop``
+#: (frame lost after bytes were written — the sender sees an
+#: ambiguous-delivery WireError and its retry implies at-least-once),
+#: ``dup`` (the frame is delivered twice — a redelivery the sender never
+#: learns about).  ``delay`` does double duty: at a serving seam it is
+#: simulated by its consequence (tripped RPC deadline), on a simnet link
+#: it is a real bounded *virtual* delay, i.e. reordering.
+FAULT_KINDS = ("oom", "preempt", "runtime", "delay", "permanent", "drop", "dup")
 
 _MESSAGES = {
     # RESOURCE_EXHAUSTED-style OOM: a co-tenant ate the HBM headroom.
@@ -76,6 +84,13 @@ _MESSAGES = {
     "(simulated slow link)",
     # Poison: a deterministic failure retries cannot cure.
     "permanent": "INVALID_ARGUMENT: poisoned dispatch (simulated) [permanent]",
+    # Link kinds (cluster/simnet.py): frame lost after the connect
+    # succeeded — delivery is ambiguous at the sender — and duplicate
+    # delivery of a frame the sender believes it sent once.
+    "drop": "UNAVAILABLE: connection reset mid-frame (simulated loss after "
+    "connect; delivery ambiguous)",
+    "dup": "UNAVAILABLE: frame redelivered (simulated at-least-once "
+    "duplicate)",
 }
 
 

@@ -85,6 +85,51 @@ def _resident_executable_count() -> int:
         return 1 << 30
 
 
+# --------------------------------------------------------------------------
+# Simnet purity guard (round 10): the deterministic cluster lane
+# (tests marked ``simnet``, over cluster/simnet.py) is only trustworthy if
+# it genuinely never touches the wall clock or the real network — the
+# moment one test quietly falls back to time.sleep or a loopback socket,
+# its determinism claim is a lie and the lane rots back into the fragile
+# timing tests it replaced.  The guard monkeypatches the two escape
+# hatches to raise AND records the violation, because a raise on a daemon
+# thread (engine loop, heartbeat thread) dies silently — the teardown
+# assert is what actually fails the test in that case.
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _simnet_purity_guard(request, monkeypatch):
+    if request.node.get_closest_marker("simnet") is None:
+        yield
+        return
+    import socket as socket_mod
+    import time as time_mod
+    import traceback
+
+    violations: list[str] = []
+
+    def _banned(what):
+        def call(*a, **k):
+            violations.append(
+                f"{what}\n" + "".join(traceback.format_stack(limit=8))
+            )
+            raise AssertionError(f"simnet purity violation: {what}")
+
+        return call
+
+    monkeypatch.setattr(socket_mod, "socket", _banned("socket.socket"))
+    monkeypatch.setattr(
+        socket_mod, "create_connection", _banned("socket.create_connection")
+    )
+    monkeypatch.setattr(
+        socket_mod, "create_server", _banned("socket.create_server")
+    )
+    monkeypatch.setattr(time_mod, "sleep", _banned("time.sleep"))
+    yield
+    assert not violations, "simnet purity violations:\n" + "\n".join(violations)
+
+
 @pytest.fixture
 def heavy_compile_guard():
     """Request this before any outsized XLA:CPU compile (see module note).

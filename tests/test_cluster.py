@@ -3,6 +3,14 @@ items 3-4, the §3.4 kill-scenario automated).
 
 These exercise membership/heartbeat/re-execution logic only, so the engines
 run an oracle-backed solve_fn — no device in the loop, sub-second tests.
+
+Since round 10 this file is the REAL-SOCKET smoke lane: it keeps the
+production transport (cluster/wire.py TcpTransport) covered end to end,
+while the timing-fragile scenarios (false death, part re-homing,
+coordinator promotion, split-brain, duplicate delivery) live in
+tests/test_simnet.py on the deterministic in-memory plane with a virtual
+clock.  The two slowest wall-clock-bound recovery scenarios here are
+marked ``slow`` — their deterministic twins run in tier-1 instead.
 """
 
 import dataclasses
@@ -261,6 +269,7 @@ def test_midjob_offload_to_idle_peer():
                 n.engine.stop(timeout=1)
 
 
+@pytest.mark.slow
 def test_part_recovery_after_peer_death():
     """ADVICE r2 #1: a SUBTASK part whose executing peer dies is re-entered
     locally from the rows retained at shed time, so the root job still
@@ -304,6 +313,7 @@ def test_part_recovery_after_peer_death():
             n.engine.stop(timeout=1)
 
 
+@pytest.mark.slow
 def test_resume_from_progress_snapshot():
     """VERDICT r1 #4: a worker streams PROGRESS snapshots; when it dies, the
     origin resumes mid-subtree and provably skips already-searched work

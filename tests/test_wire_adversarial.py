@@ -75,6 +75,139 @@ def test_unknown_method_survived(node):
     _assert_still_serving(node)
 
 
+# -- WireError delivery-ambiguity flavors (round 10) --------------------------
+#
+# Retry paths branch on WireError.ambiguous_delivery: False proves the
+# frame never reached the peer (safe to re-dispatch with no duplicate
+# possible), True means bytes were written first (the peer MAY have
+# processed the frame — re-dispatch is at-least-once and receivers must
+# dedupe).  Both flavors pinned here against the real socket layer.
+
+
+def test_connect_failure_is_unambiguous():
+    # Nothing listens on port 1: the connect itself fails, so no byte was
+    # ever written — delivery provably did not happen.
+    with pytest.raises(wire.WireError) as ei:
+        wire.send_msg(("127.0.0.1", 1), {"method": "X"}, 0.5)
+    assert ei.value.ambiguous_delivery is False
+    with pytest.raises(wire.WireError) as ei:
+        wire.request(("127.0.0.1", 1), {"method": "X"}, 0.5)
+    assert ei.value.ambiguous_delivery is False
+
+
+def test_reply_timeout_after_bytes_written_is_ambiguous():
+    # A server that accepts, reads the whole request, and never replies:
+    # the failure happens strictly after the frame went out, so the peer
+    # may have processed it — the retry layer must assume at-least-once.
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    served = threading.Event()
+
+    def serve_once():
+        conn, _ = srv.accept()
+        with conn:
+            wire.recv_msg(conn)
+            served.wait(5)  # hold the connection open, never reply
+
+    t = threading.Thread(target=serve_once, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(wire.WireError) as ei:
+            wire.request(("127.0.0.1", port), {"method": "PING"}, 0.5)
+        assert ei.value.ambiguous_delivery is True
+    finally:
+        served.set()
+        srv.close()
+
+
+def test_send_failure_after_connect_is_ambiguous(monkeypatch):
+    # A frame that dies mid-sendall (reset after the connect): some bytes
+    # may be in the peer's buffers.  Forced deterministically — a real
+    # loopback reset races kernel buffering.
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def boom(sock, msg):
+        raise OSError("connection reset by peer (forced)")
+
+    monkeypatch.setattr(wire, "_send_frame", boom)
+    try:
+        with pytest.raises(wire.WireError) as ei:
+            wire.send_msg(("127.0.0.1", port), {"method": "X"}, 0.5)
+        assert ei.value.ambiguous_delivery is True
+    finally:
+        srv.close()
+
+
+def test_oversize_frame_refused_before_send_is_unambiguous():
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    try:
+        with pytest.raises(wire.WireError) as ei:
+            wire.send_msg(
+                ("127.0.0.1", port),
+                {"method": "X", "pad": "x" * (wire.MAX_FRAME + 1)},
+                2.0,
+            )
+        # The size check rejects before any byte is written.
+        assert ei.value.ambiguous_delivery is False
+    finally:
+        srv.close()
+
+
+def test_handler_fuzz_malformed_fields(node):
+    """Round-10 satellite: drive the dispatch layer with truncated /
+    missing-field / wrong-typed messages for EVERY method and assert the
+    node logs-and-drops each one — no wedged accept loop, no leaked lock,
+    no garbage installed into the membership view."""
+    methods = [
+        "JOIN_REQ", "UPDATE_NETWORK", "HEARTBEAT", "NODE_FAILED", "LEAVE",
+        "TASK", "SOLUTION", "CANCEL", "NEEDWORK", "SUBTASK", "PART_RESULT",
+        "PROGRESS", "STATS_REQ",
+    ]
+    cases = []
+    for m in methods:
+        cases.append({"method": m})  # every field missing
+        cases.append(  # every field present, every type wrong
+            {
+                "method": m, "addr": 123, "uuid": {}, "part": [], "root": 7,
+                "grid": "not-a-grid", "origin": None, "network": 42,
+                "coordinator": [], "term": "x", "epoch": None, "from": 9,
+                "rows": {"shape": "x", "data": "!!not-base64!!"},
+                "nodes": "NaN", "solved": "y", "unsat": {}, "solution": "z",
+                "config": "bogus", "report_to": 1, "error": 0,
+            }
+        )
+    cases += [
+        # Structurally plausible but hostile membership frames: a valid-form
+        # address that was never a member, and frames naming the node itself
+        # dead — neither may corrupt the view.
+        {"method": "NODE_FAILED", "addr": "203.0.113.1:9"},
+        {"method": "LEAVE", "addr": node.addr_s},
+        {"method": "NODE_FAILED", "addr": node.addr_s},
+        {"method": "UPDATE_NETWORK", "network": [1, 2], "coordinator": "a:1",
+         "term": 99, "epoch": 99},
+        {"method": "SUBTASK", "part": "p#x", "root": "r", "report_to": "1:1",
+         "rows": {"shape": [1, 9, 9], "data": "AAAA"}},  # truncated payload
+        {"method": "PROGRESS", "uuid": "u", "rows": "nope", "nodes": 1},
+    ]
+    before = list(node.network)
+    for msg in cases:
+        wire.send_msg(node.addr, msg, 2.0)
+    # Drain: all conn threads log-and-drop, nothing wedges.
+    _assert_still_serving(node)
+    # The lock is not leaked by any failed handler.
+    assert node._lock.acquire(timeout=2), "node lock leaked by a fuzz case"
+    node._lock.release()
+    # Membership is untouched: no garbage members, node still in its view.
+    assert node.network == before
+    assert node.addr_s in node.network
+    assert all(isinstance(m, str) and ":" in m for m in node.network)
+    # Views still render.
+    node.metrics_view()
+    node.network_view()
+
+
 def test_duplicate_join_idempotent(node):
     peer = make_node(anchor=node.addr)
     try:
