@@ -184,15 +184,42 @@ def main() -> None:
     ap.add_argument("--chunk-steps", type=int, default=8)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument(
+        "--trace-out",
+        default=None,
+        help="write the run's flight-recorder trace as Chrome-trace JSON "
+        "(open in Perfetto; validate with "
+        "`python -m distributed_sudoku_solver_tpu.obs.traceck <file>`)",
+    )
     args = ap.parse_args()
 
-    out = compare_poisson(
-        n_jobs=args.jobs,
-        mean_gap_s=args.mean_ms / 1e3,
-        handicap_s=args.handicap_ms / 1e3,
-        seed=args.seed,
-        chunk_steps=args.chunk_steps,
-    )
+    rec = None
+    if args.trace_out:
+        from distributed_sudoku_solver_tpu.obs import trace as trace_mod
+
+        rec = trace_mod.TraceRecorder(ring=1 << 16)
+        trace_mod.install(rec)
+    try:
+        out = compare_poisson(
+            n_jobs=args.jobs,
+            mean_gap_s=args.mean_ms / 1e3,
+            handicap_s=args.handicap_ms / 1e3,
+            seed=args.seed,
+            chunk_steps=args.chunk_steps,
+        )
+    finally:
+        if rec is not None:
+            from distributed_sudoku_solver_tpu.obs import trace as trace_mod
+
+            trace_mod.install(None)
+            doc = rec.perfetto()
+            with open(args.trace_out, "w") as f:
+                json.dump(doc, f)
+            print(
+                f"trace written: {args.trace_out} "
+                f"({len(doc['traceEvents'])} events)",
+                file=sys.stderr,
+            )
     if args.json:
         print(json.dumps(out))
         return

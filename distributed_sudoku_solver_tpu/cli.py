@@ -129,11 +129,42 @@ def build_parser() -> argparse.ArgumentParser:
         "duplicated-work tradeoff)",
     )
     ap.add_argument(
+        "--trace",
+        action="store_true",
+        help="enable the per-job flight-recorder trace plane (obs/trace.py): "
+        "spans from HTTP accept through device chunks to resolution, "
+        "served on GET /trace[/uuid] and GET /trace?format=perfetto, with "
+        "automatic flight-recorder dumps on permanent faults and "
+        "breaker-open transitions",
+    )
+    ap.add_argument(
+        "--trace-ring",
+        type=int,
+        default=4096,
+        help="flight-recorder ring size in spans (the recent past the "
+        "trace endpoints and crash dumps can see)",
+    )
+    ap.add_argument(
+        "--trace-dump",
+        type=str,
+        default=None,
+        help="directory for automatic flight-recorder dumps "
+        "(default: <tmpdir>/dsst-flightrec when --trace is on)",
+    )
+    ap.add_argument(
+        "--access-log",
+        action="store_true",
+        help="log one INFO record per HTTP request (logger "
+        "distributed_sudoku_solver_tpu.serving.http.access); previously "
+        "access logging was silently swallowed",
+    )
+    ap.add_argument(
         "--profile-dir",
         type=str,
         default=None,
         help="capture a jax.profiler device trace into this dir "
-        "(TensorBoard-compatible; SURVEY.md §5.1)",
+        "(TensorBoard-compatible; SURVEY.md §5.1); bounded windows are "
+        "also available at runtime via POST /profile",
     )
     ap.add_argument(
         "--profile-secs",
@@ -262,6 +293,35 @@ def main(argv=None) -> None:
 
     from distributed_sudoku_solver_tpu.utils.profiling import device_trace
 
+    if args.access_log:
+        # The access logger emits INFO records; logging's lastResort
+        # handler only surfaces WARNING+ — give it a real stderr handler
+        # so the flag actually produces output on an unconfigured process.
+        import logging
+
+        acc = logging.getLogger(
+            "distributed_sudoku_solver_tpu.serving.http.access"
+        )
+        if not acc.handlers:
+            handler = logging.StreamHandler()
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(name)s %(message)s")
+            )
+            acc.addHandler(handler)
+            acc.setLevel(logging.INFO)
+    if args.trace:
+        import os
+        import tempfile
+
+        from distributed_sudoku_solver_tpu.obs import trace as trace_mod
+
+        trace_mod.install(
+            trace_mod.TraceRecorder(
+                ring=args.trace_ring,
+                dump_dir=args.trace_dump
+                or os.path.join(tempfile.gettempdir(), "dsst-flightrec"),
+            )
+        )
     trace = device_trace(args.profile_dir) if args.profile_dir else contextlib.nullcontext()
     with contextlib.ExitStack() as stack:
         # try/finally semantics: the trace survives any exit path.  A bounded
@@ -272,13 +332,14 @@ def main(argv=None) -> None:
             import threading
 
             def _stop_trace():
-                import jax
+                # Swallows only the already-stopped case; a real profiler
+                # failure is logged (utils/profiling.py satellite fix).
+                from distributed_sudoku_solver_tpu.utils.profiling import (
+                    _stop_trace_quietly,
+                )
 
-                try:
-                    jax.profiler.stop_trace()
-                    print(f"profile window closed ({args.profile_secs:g}s)")
-                except RuntimeError:
-                    pass  # already stopped (shutdown race)
+                _stop_trace_quietly()
+                print(f"profile window closed ({args.profile_secs:g}s)")
 
             timer = threading.Timer(args.profile_secs, _stop_trace)
             timer.daemon = True
@@ -296,7 +357,10 @@ def main(argv=None) -> None:
             ),
             advertise_host=args.advertise_host,
         ).start()
-        api = ApiServer(node, host=args.host, port=args.http_port, verbose=True).start()
+        api = ApiServer(
+            node, host=args.host, port=args.http_port,
+            access_log=args.access_log,
+        ).start()
         print(
             f"node up: http={args.host}:{api.port} p2p={node.addr_s} "
             f"coordinator={node.coordinator}"

@@ -54,6 +54,8 @@ import numpy as np
 from distributed_sudoku_solver_tpu.cluster import wire
 from distributed_sudoku_solver_tpu.cluster.wire import Addr, WireError, addr_str
 from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
+from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs.logctx import job_log
 from distributed_sudoku_solver_tpu.serving import faults
 from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
 
@@ -448,6 +450,10 @@ class ClusterNode:
         self.addr: Addr = (adv, bound[1])
         self.addr_s = addr_str(self.addr)
         self.anchor = anchor
+        # Trace attribution (obs/trace.py): engine spans recorded on this
+        # host carry the node's wire identity, so a stitched multi-node
+        # trace shows WHICH member ran which chunk.
+        engine.trace_node = self.addr_s
 
         self._lock = threading.RLock()
         self.network: list[str] = [self.addr_s]  # list order defines the ring
@@ -571,6 +577,18 @@ class ClusterNode:
                 )
             except faults.SimulatedFault as e:
                 raise WireError(f"injected send fault: {e}") from e
+        rec = trace.active()
+        if rec is not None:
+            # Wire-egress span for uuid-bearing frames only (heartbeats and
+            # membership noise would drown the job spans); recorded BEFORE
+            # the transport call so dropped sends still show in the trace.
+            tid = payload.get("trace") or payload.get("uuid") or payload.get("part")
+            if tid is not None:
+                rec.event(
+                    str(tid), f"send.{payload.get('method')}", "cluster.send",
+                    node=self.addr_s,
+                    peer=peer if isinstance(peer, str) else addr_str(peer),
+                )
         addr = peer if isinstance(peer, tuple) else wire.parse_addr(peer)
         self._transport.send(addr, payload, self.config.io_timeout_s)
 
@@ -598,9 +616,11 @@ class ClusterNode:
                 last = e
         if not self._stop.is_set():
             _LOG.warning(
-                "[%s] %s to %s undeliverable after %d attempts: %r",
+                "[%s] %s to %s undeliverable after %d attempts "
+                "(uuid=%s): %r",
                 self.addr_s, payload.get("method"), peer,
-                self.config.send_retries + 1, last,
+                self.config.send_retries + 1,
+                payload.get("uuid") or payload.get("part"), last,
             )
         return False
 
@@ -1207,17 +1227,19 @@ class ClusterNode:
                 "config": cfg_dict,
             }
         self._track(member, +1)
+        payload = {
+            "method": "TASK",
+            "uuid": job.uuid,
+            "grid": g.tolist(),
+            "origin": self.addr_s,
+            "config": cfg_dict,
+        }
+        if trace.active() is not None:
+            # Trace context rides the frame: the worker's spans land under
+            # this uuid and ship back on the SOLUTION (obs/trace.py).
+            payload["trace"] = job.uuid
         try:
-            self._send(
-                member,
-                {
-                    "method": "TASK",
-                    "uuid": job.uuid,
-                    "grid": g.tolist(),
-                    "origin": self.addr_s,
-                    "config": cfg_dict,
-                },
-            )
+            self._send(member, payload)
         except WireError:
             # Reliable transport tells us delivery failed -> immediate local
             # re-execution instead of the reference's silent loss (§2.5 #7).
@@ -1239,6 +1261,13 @@ class ClusterNode:
         self._track(entry["member"], -1)
         handle: Job = entry["job"]
         self._track(self.addr_s, +1)
+        rec = trace.active()
+        if rec is not None:
+            rec.event(
+                str(job_uuid), "recovery.reexecute", "cluster.recv",
+                node=self.addr_s, member=entry["member"],
+                resumed=entry.get("rows") is not None,
+            )
 
         def fin(r: dict) -> None:
             self._track(self.addr_s, -1)
@@ -1272,12 +1301,22 @@ class ClusterNode:
             # detection entirely.  Fail the handle so waiters unblock.
             self._track(self.addr_s, -1)
             handle.error = f"re-execution failed: {e}"
+            job_log(_LOG, job_uuid).error(
+                "[%s] %s", self.addr_s, handle.error
+            )
             handle.done.set()
 
     def _on_task(self, msg: dict) -> None:
         grid = np.asarray(msg["grid"], dtype=np.int32)
         origin = msg["origin"]
         ju = msg["uuid"]
+        rec = trace.active()
+        if rec is not None:
+            tid = msg.get("trace")
+            if isinstance(tid, str) and tid != ju:
+                rec.link(ju, tid)
+            rec.event(str(ju), "recv.TASK", "cluster.recv", node=self.addr_s,
+                      origin=origin)
 
         def fin(r: dict) -> None:
             payload = {
@@ -1292,6 +1331,13 @@ class ClusterNode:
                 if r["solution"] is not None
                 else None,
             }
+            rec_f = trace.active()
+            if rec_f is not None:
+                # Ship this node's spans for the trace back with the
+                # result (bounded): the origin stitches them into ONE
+                # trace for GET /trace/<uuid>.
+                payload["trace"] = rec_f.resolve(ju)
+                payload["spans"] = rec_f.export(ju)
             # At-least-once: retried on link faults (the origin dedupes by
             # uuid); if every attempt fails the origin died and its
             # successor's repair already re-executed the job.
@@ -1399,6 +1445,17 @@ class ClusterNode:
             "config": job_cfg,  # the part searches under the job's config
             "report_to": self.addr_s,
         }
+        rec = trace.active()
+        if rec is not None:
+            # Trace context: the part's spans on the peer land under the
+            # ROOT job's trace, not the derived part uuid — and the SAME
+            # link is recorded HERE, on the shedder, so the part spans the
+            # peer ships back in PART_RESULT (trace = part uuid) resolve
+            # into the root on THIS recorder too (per-process recorders:
+            # the peer's links never reach us).
+            trace_id = rec.resolve(root_uuid)
+            rec.link(part_uuid, trace_id)
+            payload["trace"] = trace_id
         try:
             self._send(requester, payload)
             self.subtasks_sent += 1
@@ -1416,6 +1473,17 @@ class ClusterNode:
         root_uuid = msg["root"]
         report_to = msg["report_to"]
         geom = geometry_for_size(rows.shape[1])
+        rec = trace.active()
+        if rec is not None:
+            tid = msg.get("trace")
+            rec.link(
+                str(part_uuid),
+                tid if isinstance(tid, str) else str(root_uuid),
+            )
+            rec.event(
+                str(part_uuid), "recv.SUBTASK", "cluster.recv",
+                node=self.addr_s, rows=rows.shape[0],
+            )
         with self._lock:
             self._parts[part_uuid] = root_uuid
         self.subtasks_run += 1
@@ -1435,6 +1503,10 @@ class ClusterNode:
                 if r["solution"] is not None
                 else None,
             }
+            rec_f = trace.active()
+            if rec_f is not None:
+                payload["trace"] = rec_f.resolve(str(part_uuid))
+                payload["spans"] = rec_f.export(str(part_uuid))
             if report_to == self.addr_s:
                 # Tag self-reported results: a no-verdict error from a LOCAL
                 # execution is terminal for the part (last resort failed),
@@ -1494,21 +1566,49 @@ class ClusterNode:
         except Exception as e:  # noqa: BLE001 - e.g. our own engine stopping
             ex.unmark_rehomed(part_uuid)
             if not self._stop.is_set():
-                _LOG.error(
+                job_log(_LOG, part_uuid).error(
                     "[%s] part re-entry failed: %r [%s]",
                     self.addr_s, e, faults.classify(e),
                 )
         else:
             with self._lock:
                 self.rehomed_parts += 1
+            rec = trace.active()
+            if rec is not None:
+                rec.event(
+                    str(part_uuid), "recovery.rehome", "cluster.recv",
+                    node=self.addr_s,
+                )
 
     def _on_part_result(self, msg: dict) -> None:
+        rec = trace.active()
+        if rec is not None:
+            part, root = msg.get("part"), msg.get("root")
+            if part is not None and root is not None:
+                # Defensive re-link (a restarted shedder's in-memory link
+                # table is gone): the ingested part spans must resolve
+                # into the root trace on this recorder.
+                rec.link(str(part), rec.resolve(str(root)))
+            rec.ingest(msg.get("spans"))
+            if part is not None:
+                rec.event(
+                    str(part), "recv.PART_RESULT", "cluster.recv",
+                    node=self.addr_s,
+                )
         with self._lock:
             ex = self._execs.get(msg["root"])
         if ex is not None:
             ex.on_part_result(msg["part"], msg)
 
     def _on_solution(self, msg: dict) -> None:
+        rec = trace.active()
+        if rec is not None:
+            rec.ingest(msg.get("spans"))
+            if msg.get("uuid") is not None:
+                rec.event(
+                    str(msg["uuid"]), "recv.SOLUTION", "cluster.recv",
+                    node=self.addr_s,
+                )
         if (
             msg.get("error")
             and not msg.get("solved")

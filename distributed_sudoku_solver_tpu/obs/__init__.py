@@ -1,0 +1,26 @@
+"""Observability: per-job flight-recorder tracing, Prometheus exposition,
+and log correlation (round 11).
+
+The serving and cluster layers gained deep *aggregate* observability over
+rounds 6-10 (``GET /metrics``: latency windows, the dispatch/sync overlap
+split, fault counters, breaker states) — but when one job's p95 blows up
+or a breaker opens, aggregates cannot say *which* job took *which* path
+through *which* chunks.  This package holds the per-job plane:
+
+* :mod:`obs.trace` — a process-wide, clock-injectable span recorder with a
+  bounded ring (flight recorder).  Instrumentation points reuse the fault
+  plane's site vocabulary (``serving/faults.py`` ``fire`` sites and the
+  cluster wire egress), recording is guarded exactly like
+  ``faults.active()`` (disabled = one branch, zero allocation), and the
+  spans add **zero host syncs** — enforced by the round-8
+  one-sync-per-chunk guard running with tracing enabled.
+* :mod:`obs.traceck` — validator for exported Chrome-trace JSON
+  (``python -m distributed_sudoku_solver_tpu.obs.traceck trace.json``).
+* :mod:`obs.prom` — Prometheus text exposition of the nested
+  ``/metrics`` dict (``GET /metrics?format=prometheus``).
+* :mod:`obs.logctx` — uuid-carrying log adapters so engine/scheduler/
+  cluster records that concern a job are grep-correlatable with its trace.
+
+Import discipline: stdlib only, like ``serving/faults.py`` — every layer
+imports ``obs``; ``obs`` imports none of them back.
+"""

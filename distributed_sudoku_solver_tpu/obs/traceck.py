@@ -1,0 +1,106 @@
+"""Chrome-trace JSON validator (``traceck``): the tooling half of the
+trace plane's contract.
+
+``TraceRecorder.perfetto`` (and everything built on it: ``GET
+/trace?format=perfetto``, ``bench_poisson.py --trace-out``) promises valid
+Chrome-trace JSON with monotone spans; this module is the executable form
+of that promise, used by the tests and runnable standalone::
+
+    python -m distributed_sudoku_solver_tpu.obs.traceck trace.json
+
+Checks (returns a list of error strings; empty = well-formed):
+
+* top level is an object with a ``traceEvents`` list;
+* every event is an object with string ``name``, ``ph`` in the emitted
+  set (``X`` complete, ``M`` metadata), integer ``pid``/``tid``;
+* ``X`` events carry numeric ``ts >= 0`` and ``dur >= 0``;
+* spans are monotone: within each ``(pid, tid)`` lane, ``X`` events'
+  ``ts`` never decreases (Perfetto renders out-of-order slices as a
+  corrupt-looking track).
+
+Stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Union
+
+_ALLOWED_PH = {"X", "M", "i", "I"}
+
+
+def check(doc) -> List[str]:
+    """Validate a parsed Chrome-trace document; returns error strings."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"top level must be an object, got {type(doc).__name__}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing or non-list 'traceEvents'"]
+    last_ts: dict = {}  # (pid, tid) -> last X-event ts
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        name = ev.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{where}: missing/empty 'name'")
+        ph = ev.get("ph")
+        if ph not in _ALLOWED_PH:
+            errors.append(f"{where}: bad 'ph' {ph!r}")
+            continue
+        pid, tid = ev.get("pid"), ev.get("tid")
+        if not isinstance(pid, int) or not isinstance(tid, int):
+            errors.append(f"{where}: pid/tid must be integers")
+            continue
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"{where}: bad 'ts' {ts!r}")
+            continue
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: bad 'dur' {dur!r}")
+            lane = (pid, tid)
+            if ts < last_ts.get(lane, float("-inf")):
+                errors.append(
+                    f"{where}: non-monotone ts {ts} after "
+                    f"{last_ts[lane]} in lane pid={pid} tid={tid}"
+                )
+            else:
+                last_ts[lane] = ts
+    return errors
+
+
+def check_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable or not JSON: {e}"]
+    return check(doc)
+
+
+def main(argv: Union[List[str], None] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m distributed_sudoku_solver_tpu.obs.traceck "
+              "<trace.json>", file=sys.stderr)
+        return 2
+    errors = check_file(argv[0])
+    if errors:
+        for e in errors:
+            print(f"traceck: {e}", file=sys.stderr)
+        return 1
+    with open(argv[0]) as f:
+        n = len(json.load(f).get("traceEvents", []))
+    print(f"traceck: OK ({n} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

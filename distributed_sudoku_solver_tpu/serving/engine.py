@@ -54,6 +54,8 @@ import jax
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
+from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs.logctx import job_log, uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
 from distributed_sudoku_solver_tpu.ops.solve import solve_batch
 from distributed_sudoku_solver_tpu.serving import faults
@@ -140,6 +142,11 @@ class Job:
     fault_retries: int = 0
     last_fault: Optional[str] = None
     bisect_token: Optional[int] = None
+    # Trace-clock submit time (obs/trace.py): set only when a recorder is
+    # installed, read by the admission span so the queue wait is measured
+    # on the RECORDER's clock (virtual in simnet tests) — `submitted_at`
+    # stays on the wall clock for latency/deadline semantics.
+    trace_t0: Optional[float] = None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -317,6 +324,10 @@ class SolverEngine:
         self._occ_hist = np.zeros(10, np.int64)
         self._occ_frac_sum = 0.0
         self._occ_chunks = 0
+        # Node identity for trace spans (obs/trace.py): a cluster node sets
+        # this to its wire address so a stitched multi-node trace
+        # attributes each engine span to the host that recorded it.
+        self.trace_node: Optional[str] = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "SolverEngine":
@@ -358,6 +369,9 @@ class SolverEngine:
         job = Job(
             uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom, config=config
         )
+        rec = trace.active()
+        if rec is not None:
+            job.trace_t0 = rec.now()
         if deadline_s is not None:
             job.deadline = job.submitted_at + deadline_s
         if self._route_resident(job, saturation):
@@ -462,6 +476,9 @@ class SolverEngine:
             roots=r,
             config=config,
         )
+        rec = trace.active()
+        if rec is not None:
+            job.trace_t0 = rec.now()
         self._enqueue(job)
         return job
 
@@ -638,6 +655,11 @@ class SolverEngine:
         if inj is not None:
             fa["injector"] = inj.metrics()
         out["faults"] = fa
+        rec = trace.active()
+        if rec is not None:
+            # Flight-recorder health: ring fill, links, dumps written,
+            # spans stitched in from remote nodes (obs/trace.py).
+            out["trace"] = rec.metrics()
         if self._occ_chunks > 0:
             # Lane-occupancy inside fused dispatches: counts[k] = lanes
             # observed live for [10k, 10(k+1))% of the rounds their chunk
@@ -721,8 +743,8 @@ class SolverEngine:
                         self._solve_group(geom, group, cfg)
                 except Exception as e:  # noqa: BLE001
                     _LOG.error(
-                        "[engine] batch failed (%s): %r [%s]",
-                        geom, e, faults.classify(e),
+                        "[engine] batch failed (%s, %s): %r [%s]",
+                        geom, uuids_label(group), e, faults.classify(e),
                     )
                     self._recover_group(group, cfg, e)
             self._service_controls()
@@ -745,8 +767,10 @@ class SolverEngine:
                     # permanent one (or a tripped circuit breaker) routes
                     # them to static flights; the loop keeps serving.
                     _LOG.error(
-                        "[engine] resident flight failed (%s): %r [%s]",
-                        rf.geom, e, faults.classify(e),
+                        "[engine] resident flight failed (%s, %s): %r [%s]",
+                        rf.geom,
+                        uuids_label([j for j in rf.slots if j is not None]),
+                        e, faults.classify(e),
                     )
                     rf.on_failure(e)
             # Round-robin: advance every active flight by one chunk.
@@ -756,8 +780,8 @@ class SolverEngine:
                 except Exception as e:  # noqa: BLE001
                     self._flights.remove(fl)
                     _LOG.error(
-                        "[engine] flight failed (%s): %r [%s]",
-                        fl.geom, e, faults.classify(e),
+                        "[engine] flight failed (%s, %s): %r [%s]",
+                        fl.geom, uuids_label(fl.jobs), e, faults.classify(e),
                     )
                     self._recover_jobs(
                         [j for j in fl.jobs if not j.done.is_set()],
@@ -798,9 +822,16 @@ class SolverEngine:
             return
         kind = faults.classify(exc)
         label = f"{type(exc).__name__}: {exc}"
+        rec = trace.active()
         if kind == faults.PERMANENT:
             if len(jobs) > 1:
                 self.fault_bisections += 1
+                if rec is not None:
+                    rec.event(
+                        None, "recovery.bisect", "engine.recovery",
+                        node=self.trace_node,
+                        uuids=[j.uuid for j in jobs], error=label,
+                    )
                 mid = len(jobs) // 2
                 for half in (jobs[:mid], jobs[mid:]):
                     self._bisect_seq += 1
@@ -809,19 +840,39 @@ class SolverEngine:
                         job.last_fault = kind
                         self._requeue(job)
                 _LOG.error(
-                    "[engine] permanent batch failure: bisecting %d jobs "
-                    "to isolate the poison dispatch", len(jobs),
+                    "[engine] permanent batch failure (%s): bisecting %d "
+                    "jobs to isolate the poison dispatch",
+                    uuids_label(jobs), len(jobs),
                 )
             else:
                 for job in jobs:
                     job.error = label
                     job.done.set()
                     self.fault_permanent += 1
+                    job_log(_LOG, job.uuid).error(
+                        "[engine] permanent failure: %s", label
+                    )
+                    if rec is not None:
+                        rec.event(
+                            job.uuid, "fault.permanent", "engine.recovery",
+                            node=self.trace_node, error=label,
+                        )
+                if rec is not None:
+                    # The flight-recorder moment: an isolated permanent
+                    # fault just failed a paying job — dump the recent
+                    # ring + a metrics snapshot for the post-mortem.
+                    rec.dump("permanent_fault", metrics=self.metrics())
             return
         degraded = self._degrade(cfg, exc)
         for job in jobs:
             if not self._charge_retry(job, kind, label):
                 continue
+            if rec is not None:
+                rec.event(
+                    job.uuid, "recovery.requeue", "engine.recovery",
+                    node=self.trace_node, kind=kind,
+                    retry=job.fault_retries,
+                )
             # Pin the (possibly degraded) config on the job: the requeue
             # must not re-enter the resident path (that flight has its own
             # breaker) and must group under the degraded config.
@@ -843,6 +894,13 @@ class SolverEngine:
             )
             job.done.set()
             self.fault_budget_exhausted += 1
+            job_log(_LOG, job.uuid).error("[engine] %s", job.error)
+            rec = trace.active()
+            if rec is not None:
+                rec.event(
+                    job.uuid, "recovery.budget_exhausted", "engine.recovery",
+                    node=self.trace_node, error=job.error,
+                )
             return False
         self.fault_retries_total += 1
         return True
@@ -872,10 +930,16 @@ class SolverEngine:
         stack); a multi-job group split into more flights keeps roughly
         the same AGGREGATE persistent frontier HBM, which no width cap can
         shrink — only the retry budget bounds that failure mode."""
+        rec = trace.active()
         if faults.is_oom(exc):
             lanes = cfg.lanes if cfg.lanes > 0 else cfg.min_lanes
             halved = max(1, lanes // 2)
             self.fault_lane_halvings += 1
+            if rec is not None:
+                rec.event(
+                    None, "recovery.downgrade", "engine.recovery",
+                    node=self.trace_node, rung="lanes_halved", lanes=halved,
+                )
             new = dataclasses.replace(
                 cfg, lanes=halved, min_lanes=min(cfg.min_lanes, halved)
             )
@@ -886,6 +950,11 @@ class SolverEngine:
             return new
         if cfg.step_impl == "fused":
             self.fault_downgrades_fused += 1
+            if rec is not None:
+                rec.event(
+                    None, "recovery.downgrade", "engine.recovery",
+                    node=self.trace_node, rung="fused_to_composite",
+                )
             return dataclasses.replace(cfg, step_impl="xla")
         return cfg
 
@@ -1001,6 +1070,14 @@ class SolverEngine:
         roots[: len(r)] = r
         valid = np.arange(bucket) < len(r)
         cfg = self._fit_fused(geom, cfg, cfg.resolve_lanes_packed(bucket))
+        rec = trace.active()
+        if rec is not None:
+            # Admission span: submit -> launch is the static queue wait.
+            rec.record(
+                job.uuid, "admission", "engine.launch",
+                t0=job.trace_t0 if job.trace_t0 is not None else rec.now(),
+                node=self.trace_node, route="static", roots=len(r),
+            )
         if faults.active() is not None:
             faults.fire("engine.launch", uuids=(job.uuid,))
         state = _start_packed(jnp.asarray(roots), jnp.asarray(valid), cfg)
@@ -1024,6 +1101,16 @@ class SolverEngine:
         roots[: len(jobs)] = np.asarray(encode_grid(jnp.asarray(grids), geom), np.uint32)
         job_of_root[: len(jobs)] = np.arange(len(jobs), dtype=np.int32)
         cfg = self._fit_fused(geom, cfg, cfg.resolve_lanes(bucket))
+        rec = trace.active()
+        if rec is not None:
+            now = rec.now()
+            for job in jobs:
+                rec.record(
+                    job.uuid, "admission", "engine.launch",
+                    t0=job.trace_t0 if job.trace_t0 is not None else now,
+                    t1=now, node=self.trace_node, route="static",
+                    config_override=job.config is not None,
+                )
         if faults.active() is not None:
             faults.fire("engine.launch", uuids=tuple(j.uuid for j in jobs))
         state = _start_roots(
@@ -1052,6 +1139,14 @@ class SolverEngine:
 
         from distributed_sudoku_solver_tpu.ops.frontier import unpack_status
 
+        # Tracing guard (obs/trace.py): disabled = this one read + branches
+        # on `rec is not None` — no clock reads, no uuid tuples, no span
+        # dicts.  Enabled, every span is built from host-side values the
+        # loop already holds: tracing adds ZERO host syncs, which the
+        # fetch-count guard enforces by running with tracing on.
+        rec = trace.active()
+        tr0 = rec.now() if rec is not None else 0.0
+        live_uuids = ()  # the shared empty tuple: no per-chunk allocation
         t_pass = time.monotonic()
         # Mid-flight cancellation + deadline expiry: purge the jobs' lanes
         # in-graph (async dispatch — the purge rides the device queue ahead
@@ -1113,6 +1208,13 @@ class SolverEngine:
         prev_status = fl.pending_status
         fl.pending_status = status_dev
         self.dispatch_wall.record(time.monotonic() - t_pass)
+        if rec is not None:
+            live_uuids = [j.uuid for j in fl.jobs if not j.done.is_set()]
+            rec.record(
+                None, "chunk.dispatch", "engine.advance", tr0,
+                node=self.trace_node, uuids=live_uuids, chunk=fl.chunks,
+                geometry=f"{fl.geom.n}x{fl.geom.n}",
+            )
         if prev_status is None:
             # Newborn flight: chunk 0 is in the device queue and the loop
             # moves on — the flight is a full dispatch ahead from birth.
@@ -1120,12 +1222,19 @@ class SolverEngine:
         # The chunk's single host sync.  The status word is sized by the
         # frontier's padded job dimension (the bucket), not len(fl.jobs) —
         # padding rows are never seeded, so their bits stay False.
+        tr1 = rec.now() if rec is not None else 0.0
         t_sync = time.monotonic()
         info = unpack_status(
             host_fetch(prev_status, floor_s=self.handicap_s),
             fl.state.solved.shape[0],
         )
         self.sync_wall.record(time.monotonic() - t_sync)
+        if rec is not None:
+            rec.record(
+                None, "chunk.sync", "fetch.status", tr1,
+                node=self.trace_node, uuids=live_uuids,
+                steps=int(info["steps"]),
+            )
         wall = time.monotonic() - t_pass
         self.chunk_wall.record(wall)
         self._chunk_wall_total += wall
@@ -1159,6 +1268,7 @@ class SolverEngine:
         res = _finalize_jit(fl.state)
         fl.state = None
         fl.pending_status = None
+        tr_ev = rec.now() if rec is not None else 0.0
         t_ev = time.monotonic()
         solutions, unsat, nodes, solved, sol_counts = host_fetch(
             (res.solution, res.unsat, res.nodes, res.solved, res.sol_count),
@@ -1166,6 +1276,11 @@ class SolverEngine:
             tag="finalize",
         )
         self.event_wall.record(time.monotonic() - t_ev)
+        if rec is not None:
+            rec.record(
+                None, "finalize.sync", "fetch.finalize", tr_ev,
+                node=self.trace_node, uuids=live_uuids,
+            )
         for i, job in enumerate(fl.jobs):
             if job.done.is_set():
                 continue
@@ -1200,6 +1315,8 @@ class SolverEngine:
         9x9 bucket (under one RPC floor through the tunnel); a static-K
         in-graph gather is the upgrade path if giant-geometry buckets
         ever serve interactively."""
+        rec = trace.active()
+        tr_ev = rec.now() if rec is not None else 0.0
         t_ev = time.monotonic()
         solutions, nodes = host_fetch(
             _flight_verdict_jit(fl.state),
@@ -1208,6 +1325,12 @@ class SolverEngine:
         )
         ev = time.monotonic() - t_ev
         self.event_wall.record(ev)
+        if rec is not None:
+            rec.record(
+                None, "verdict.sync", "fetch.event", tr_ev,
+                node=self.trace_node,
+                uuids=[fl.jobs[i].uuid for i in idx],
+            )
         # This fetch blocked out chunk k+1's device wall; without this the
         # step_wall_ms_avg numerator misses exactly the chunks that
         # resolved jobs (their steps still land in _chunk_steps_total at
@@ -1226,6 +1349,13 @@ class SolverEngine:
             self.solved_count += 1
         self.validations += job.nodes
         self.jobs_done += 1
+        rec = trace.active()
+        if rec is not None:
+            rec.event(
+                job.uuid, "resolve", "engine.resolve", node=self.trace_node,
+                solved=job.solved, unsat=job.unsat, cancelled=job.cancelled,
+                nodes=job.nodes, error=job.error,
+            )
         job.done.set()
 
     # -- control requests (snapshot / shed) ----------------------------------
@@ -1369,6 +1499,7 @@ class SolverEngine:
         sol_counts = np.asarray(getattr(res, "sol_count", solved.astype(np.int32)))
 
         now = time.monotonic()
+        rec = trace.active()
         for i, job in enumerate(group):
             job.solved = bool(solved[i])
             job.unsat = bool(unsat[i])
@@ -1379,6 +1510,13 @@ class SolverEngine:
             if self._consume_cancel(job):
                 job.cancelled = True
             self.latency.record(now - job.submitted_at)
+            if rec is not None:
+                rec.event(
+                    job.uuid, "resolve", "engine.resolve",
+                    node=self.trace_node, solved=job.solved,
+                    unsat=job.unsat, cancelled=job.cancelled,
+                    nodes=job.nodes, error=job.error,
+                )
             job.done.set()
         self.batch_sizes.record(float(len(group)))
         self.validations += int(nodes[: len(group)].sum())

@@ -59,17 +59,39 @@ Differences are deliberate upgrades, not behavior drift:
   (``serving/engine.py``).
 * ``/stats`` aggregation uses the cluster runtime's snapshot instead of a
   blind 1 s sleep window (``:571``).
+
+Observability endpoints (round 11, ``obs/``):
+
+* ``GET /trace`` — recent flight-recorder spans (JSON);
+  ``?format=perfetto`` exports the ring as Chrome-trace JSON (open in
+  Perfetto / chrome://tracing; validated by ``obs/traceck.py``).  404
+  unless a recorder is installed (``--trace``).
+* ``GET /trace/<uuid>`` — one job's stitched trace (spans from every
+  cluster node that touched it).
+* ``GET /metrics?format=prometheus`` — the nested metrics dict flattened
+  into Prometheus text exposition (``obs/prom.py``).
+* ``POST /profile`` ``{"secs": 1.0, "logdir": "..."} `` — a bounded
+  ``jax.profiler`` device-trace window (``utils/profiling.py``); one
+  window at a time (409 while open).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
+from distributed_sudoku_solver_tpu.obs import trace
 from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
 from distributed_sudoku_solver_tpu.serving.scheduler import EngineSaturated
+
+# Opt-in access log (--access-log): routed through logging, not the
+# stdlib handler's bare stderr write, so deployments aggregate it like
+# every other record.
+_ACCESS_LOG = logging.getLogger(__name__ + ".access")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -79,6 +101,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 (stdlib casing)
         if self.path == "/solve_batch":
             return self._solve_batch()
+        if self.path == "/profile":
+            return self._profile()
         if self.path != "/solve":
             return self._send(404, {"error": "not found"})
         try:
@@ -103,6 +127,8 @@ class _Handler(BaseHTTPRequestHandler):
                 400, {"error": f"sudoku must be a square grid, got shape {g.shape}"}
             )
         start = time.time()
+        rec = trace.active()
+        t_http = rec.now() if rec is not None else 0.0
         timeout = self.server.solve_timeout_s
         if payload.get("count_all"):
             if payload.get("portfolio"):
@@ -151,23 +177,34 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if not job.wait(timeout):
                 node.cancel(job.uuid)
+                self._trace_http(rec, t_http, job.uuid, 504)
                 return self._send(504, {"error": "solve timed out", "uuid": job.uuid})
         duration = time.time() - start
         extra = {"strategy": strategy} if strategy is not None else {}
         if job.solved:
+            self._trace_http(rec, t_http, job.uuid, 201)
             return self._send(
                 201,
                 {"solution": job.solution.tolist(), "duration": duration, **extra},
             )
         if job.unsat:
+            self._trace_http(rec, t_http, job.uuid, 422)
             return self._send(
                 422,
                 {"error": "puzzle is unsatisfiable", "duration": duration, **extra},
             )
+        self._trace_http(rec, t_http, job.uuid, 500)
         return self._send(
             500,
             {"error": job.error or "search budget exhausted", "duration": duration},
         )
+
+    @staticmethod
+    def _trace_http(rec, t0: float, job_uuid: str, status: int) -> None:
+        """The trace's outermost span: HTTP accept -> response for one job
+        (obs/trace.py; a no-op unless a recorder is installed)."""
+        if rec is not None:
+            rec.record(job_uuid, "http.solve", "http", t0, status=status)
 
     def _solve_count_all(self, node, grid, start, timeout):
         """``POST /solve`` with ``"count_all": true``: enumerate EVERY
@@ -352,17 +389,79 @@ class _Handler(BaseHTTPRequestHandler):
             body["solutions"] = solutions.tolist()
         return self._send(200, body)
 
+    def _profile(self):
+        """``POST /profile``: a bounded jax.profiler device-trace window —
+        ``utils/profiling.device_trace`` finally wired to serving.  One
+        window at a time; the stop is a daemon timer, so a forgotten
+        client can never leave a node tracing unboundedly."""
+        import tempfile
+
+        from distributed_sudoku_solver_tpu.utils import profiling
+
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length)) if length else {}
+            secs = float(payload.get("secs", 1.0))
+            if not (0.05 <= secs <= 300.0):
+                raise ValueError(f"secs must be in [0.05, 300], got {secs}")
+            logdir = str(
+                payload.get("logdir")
+                or tempfile.mkdtemp(prefix="dsst-profile-")
+            )
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            return self._send(400, {"error": f"bad profile body: {e}"})
+        try:
+            started = profiling.start_profile_window(logdir, secs)
+        except Exception as e:  # noqa: BLE001 - profiler state is global
+            # (e.g. a --profile-dir lifetime trace already running)
+            return self._send(409, {"error": f"profiler unavailable: {e}"})
+        if not started:
+            return self._send(409, {"error": "a profile window is already open"})
+        return self._send(200, {"logdir": logdir, "secs": secs})
+
     def do_GET(self):  # noqa: N802
         node = self.server.solver_node
-        if self.path == "/stats":
+        url = urlsplit(self.path)
+        path, query = url.path, parse_qs(url.query)
+        if path == "/stats":
             return self._send(200, node.stats_view())
-        if self.path == "/network":
+        if path == "/network":
             return self._send(200, node.network_view())
-        if self.path == "/metrics":
+        if path == "/metrics":
             # Superset endpoint (not in the reference): per-node latency
             # percentiles, batch sizes, device info — SURVEY.md §5.5.
+            # ?format=prometheus flattens the nested dict into text
+            # exposition lines (obs/prom.py) for direct scraping.
+            if query.get("format", [""])[0] == "prometheus":
+                from distributed_sudoku_solver_tpu.obs import prom
+
+                return self._send_text(200, prom.render(self._metrics(node)))
             return self._send(200, self._metrics(node))
+        if path == "/trace" or path.startswith("/trace/"):
+            return self._trace_view(path, query)
         return self._send(404, {"error": "not found"})
+
+    def _trace_view(self, path: str, query: dict):
+        """``GET /trace`` (recent ring; ``?format=perfetto`` for Chrome-
+        trace JSON) and ``GET /trace/<uuid>`` (one job's stitched spans)."""
+        rec = trace.active()
+        if rec is None:
+            return self._send(
+                404, {"error": "tracing disabled (start the node with --trace)"}
+            )
+        if path.startswith("/trace/"):
+            uuid = path[len("/trace/") :]
+            spans = rec.spans(uuid)
+            return self._send(200, {"uuid": uuid, "count": len(spans),
+                                    "spans": spans})
+        if query.get("format", [""])[0] == "perfetto":
+            return self._send(200, rec.perfetto())
+        try:
+            limit = int(query.get("limit", ["1000"])[0])
+        except ValueError:
+            return self._send(400, {"error": "limit must be an integer"})
+        spans = rec.spans(limit=max(1, limit))
+        return self._send(200, {"count": len(spans), "spans": spans})
 
     @staticmethod
     def _metrics(node) -> dict:
@@ -390,13 +489,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def log_message(self, fmt, *args):  # quiet by default; engine has counters
-        if self.server.verbose:
-            super().log_message(fmt, *args)
+    def _send_text(self, code: int, text: str) -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):
+        # Access logging is OPT-IN (--access-log) and routed through
+        # `logging` — the old `verbose` gate wrote to bare stderr via the
+        # stdlib handler and was silently swallowed everywhere else.
+        if getattr(self.server, "access_log", False):
+            _ACCESS_LOG.info("%s %s", self.address_string(), fmt % args)
 
 
 class ApiServer:
-    """ThreadingHTTPServer wrapper bound to a solver node (or bare engine)."""
+    """ThreadingHTTPServer wrapper bound to a solver node (or bare engine).
+
+    ``access_log=True`` emits one INFO record per request on the
+    ``...serving.http.access`` logger (``--access-log`` on the CLI);
+    ``verbose`` is the deprecated alias it replaces.
+    """
 
     def __init__(
         self,
@@ -405,11 +520,12 @@ class ApiServer:
         port: int = 8000,
         solve_timeout_s: float = 300.0,
         verbose: bool = False,
+        access_log: bool = False,
     ):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.solver_node = solver_node
         self.httpd.solve_timeout_s = solve_timeout_s
-        self.httpd.verbose = verbose
+        self.httpd.access_log = access_log or verbose
         self._thread: Optional[threading.Thread] = None
 
     @property

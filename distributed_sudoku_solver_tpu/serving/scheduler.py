@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import math
 import threading
 import time
@@ -64,6 +65,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs.logctx import uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import (
     Frontier,
     SolverConfig,
@@ -73,6 +76,8 @@ from distributed_sudoku_solver_tpu.ops.frontier import (
 )
 from distributed_sudoku_solver_tpu.serving import engine as engine_mod
 from distributed_sudoku_solver_tpu.serving import faults
+
+_LOG = logging.getLogger(__name__)
 
 # The resident frontier never retires, so the per-solve step budget is
 # replaced by wall-clock deadlines; int32 max keeps run_frontier's
@@ -432,6 +437,8 @@ class ResidentFlight:
         single host sync); no-op when no advance is outstanding."""
         if self._pending_status is None:
             return
+        rec = trace.active()
+        tr0 = rec.now() if rec is not None else 0.0
         t0 = time.monotonic()
         raw = engine_mod.host_fetch(
             self._pending_status, floor_s=self.engine.handicap_s
@@ -443,7 +450,24 @@ class ResidentFlight:
         # A consumed chunk is the breaker's definition of success: it
         # resets the consecutive-failure count and closes a half-open
         # breaker (the probe rebuild proved the device serves again).
-        self.breaker.record_success()
+        if rec is None:
+            self.breaker.record_success()
+        else:
+            rec.record(
+                None, "resident.sync", "fetch.status", tr0,
+                node=self.engine.trace_node, chunk=self.chunks,
+                geometry=f"{self.geom.n}x{self.geom.n}",
+                uuids=[j.uuid for j in self.slots if j is not None],
+            )
+            before = self.breaker.state
+            self.breaker.record_success()
+            if self.breaker.state != before:
+                rec.event(
+                    None, "breaker", "resident.breaker",
+                    node=self.engine.trace_node,
+                    geometry=f"{self.geom.n}x{self.geom.n}",
+                    attrs={"from": before, "to": self.breaker.state},
+                )
 
     def _resolve_dead(self, job, cancelled: bool) -> None:
         """Resolve a job that leaves the scheduler with no verdict: either
@@ -525,6 +549,8 @@ class ResidentFlight:
             solved[slot] or (not has_work[slot] and not cancelled)
             for slot, job, cancelled, expired in leaving
         ):
+            rec = trace.active()
+            tr_ev = rec.now() if rec is not None else 0.0
             t_ev = time.monotonic()
             nodes, sol_counts, overflowed, solutions = engine_mod.host_fetch(
                 _verdict_jit(self.state),
@@ -533,6 +559,12 @@ class ResidentFlight:
             )
             self._event_wall = time.monotonic() - t_ev
             self.event_wall.record(self._event_wall)
+            if rec is not None:
+                rec.record(
+                    None, "verdict.sync", "fetch.event", tr_ev,
+                    node=self.engine.trace_node,
+                    uuids=[j.uuid for _, j, _, _ in leaving],
+                )
         for slot, job, cancelled, expired in leaving:
             if solved[slot]:
                 job.solved = True
@@ -597,6 +629,19 @@ class ResidentFlight:
             batch.append((slot, job))
         if not batch:
             return
+        rec = trace.active()
+        if rec is not None:
+            t1 = rec.now()
+            for slot, job in batch:
+                # Admission span: submit -> attach is the resident queue
+                # wait, the per-job number the aggregate
+                # admission_wait_ms window cannot attribute.
+                rec.record(
+                    job.uuid, "admission", "resident.attach",
+                    t0=job.trace_t0 if job.trace_t0 is not None else t1,
+                    t1=t1, node=self.engine.trace_node, route="resident",
+                    slot=slot,
+                )
         if faults.active() is not None:
             faults.fire(
                 "resident.attach", uuids=tuple(job.uuid for _, job in batch)
@@ -649,9 +694,17 @@ class ResidentFlight:
                 "resident.advance",
                 uuids=tuple(j.uuid for j in self.slots if j is not None),
             )
+        rec = trace.active()
+        tr0 = rec.now() if rec is not None else 0.0
         self.state, self._pending_status = _advance_fn(
             self.state, jnp.int32(self.rcfg.chunk_steps), self.geom, self.config
         )
+        if rec is not None:
+            rec.record(
+                None, "resident.chunk.dispatch", "resident.advance", tr0,
+                node=self.engine.trace_node,
+                uuids=[j.uuid for j in self.slots if j is not None],
+            )
 
     def on_failure(self, exc: BaseException) -> None:
         """A device program died mid-round (attach/advance/status): recover
@@ -673,6 +726,8 @@ class ResidentFlight:
         """
         kind = faults.classify(exc)
         label = f"{type(exc).__name__}: {exc}"
+        rec = trace.active()
+        breaker_before = self.breaker.state
         self.breaker.record_failure()
         self.state = None
         self._pending_status = None
@@ -689,6 +744,18 @@ class ResidentFlight:
             if not job.done.is_set()
             and self.engine._charge_retry(job, kind, label)
         ]
+        if rec is not None and self.breaker.state != breaker_before:
+            geometry = f"{self.geom.n}x{self.geom.n}"
+            rec.event(
+                None, "breaker", "resident.breaker",
+                node=self.engine.trace_node, geometry=geometry,
+                attrs={"from": breaker_before, "to": self.breaker.state},
+            )
+            if self.breaker.state == self.breaker.OPEN:
+                # The other flight-recorder moment: admission is about to
+                # deflect this geometry's traffic — dump the recent ring
+                # and metrics so the opening is reconstructible.
+                rec.dump("breaker_open", metrics=self.engine.metrics())
         if kind == faults.PERMANENT or self.breaker.state == self.breaker.OPEN:
             for job in survivors:
                 self.engine._requeue(job)
@@ -696,6 +763,18 @@ class ResidentFlight:
             if kind == faults.PERMANENT:
                 with self._lock:
                     self._closed = True
+            _LOG.warning(
+                "[resident %sx%s] %s failure: rerouted %d jobs to static "
+                "flights (%s): %s",
+                self.geom.n, self.geom.n, kind, len(survivors),
+                uuids_label(survivors), label,
+            )
+            if rec is not None:
+                rec.event(
+                    None, "recovery.reroute", "resident.recovery",
+                    node=self.engine.trace_node, kind=kind,
+                    uuids=[j.uuid for j in survivors], error=label,
+                )
         else:
             # Rebuild path: jobs go back to the front of the admission
             # queue in order; the cooldown keeps back-to-back failure
@@ -707,6 +786,18 @@ class ResidentFlight:
             self._cooldown_until = (
                 self.policy.clock() + self.policy.rebuild_cooldown_s
             )
+            _LOG.warning(
+                "[resident %sx%s] transient failure: rebuild scheduled, "
+                "%d jobs requeued (%s): %s",
+                self.geom.n, self.geom.n, len(survivors),
+                uuids_label(survivors), label,
+            )
+            if rec is not None:
+                rec.event(
+                    None, "recovery.rebuild", "resident.recovery",
+                    node=self.engine.trace_node, kind=kind,
+                    uuids=[j.uuid for j in survivors], error=label,
+                )
 
     def fail(self, exc: BaseException) -> None:
         """Terminal failure (no recovery): fail every job this flight

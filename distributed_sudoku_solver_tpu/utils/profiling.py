@@ -17,10 +17,32 @@ is tautological, ``/root/reference/DHT_Node.py:223``) plus a wall-clock
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 from typing import Iterator, Optional
 
 import numpy as np
+
+_LOG = logging.getLogger(__name__)
+
+
+def _stop_trace_quietly() -> None:
+    """Stop the jax profiler, swallowing ONLY the documented already-
+    stopped case (a bounded window timer or a concurrent stop got there
+    first — jax raises ``RuntimeError("No profile started")``-shaped
+    errors for it).  Anything else is a *real* profiler failure (e.g. a
+    trace-export error losing the capture) and is logged instead of
+    hidden — the pre-round-11 bare ``except RuntimeError: pass`` could
+    mask those forever."""
+    import jax
+
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "no profile" in msg or "not started" in msg:
+            return
+        _LOG.error("[profiling] stop_trace failed: %r", e)
 
 
 @contextlib.contextmanager
@@ -38,10 +60,49 @@ def device_trace(logdir: str) -> Iterator[None]:
     try:
         yield
     finally:
-        try:
-            jax.profiler.stop_trace()
-        except RuntimeError:
-            pass  # already stopped (bounded --profile-secs window fired)
+        _stop_trace_quietly()
+
+
+# -- bounded serving profile window (POST /profile) ---------------------------
+#
+# The serving wire-up of device_trace: one bounded capture window at a
+# time, started by an HTTP request and closed by a daemon timer — a
+# long-lived node must never be left tracing unboundedly because a client
+# forgot a second request.
+
+_window_lock = threading.Lock()
+_window_active = False
+
+
+def profile_window_active() -> bool:
+    with _window_lock:
+        return _window_active
+
+
+def start_profile_window(logdir: str, secs: float) -> bool:
+    """Start a jax.profiler capture into ``logdir`` that self-stops after
+    ``secs``.  Returns False if a window is already open (the caller
+    answers 409); propagates the profiler's own error if the start itself
+    fails (e.g. a ``--profile-dir`` lifetime trace already running)."""
+    global _window_active
+    import jax
+
+    with _window_lock:
+        if _window_active:
+            return False
+        jax.profiler.start_trace(logdir)
+        _window_active = True
+    timer = threading.Timer(secs, _close_profile_window)
+    timer.daemon = True
+    timer.start()
+    return True
+
+
+def _close_profile_window() -> None:
+    global _window_active
+    with _window_lock:
+        _stop_trace_quietly()
+        _window_active = False
 
 
 class StatWindow:

@@ -241,3 +241,109 @@ def test_solve_count_all_endpoint(server):
     assert body["count"] == 288
     assert body["complete"] is True
     assert body["solution"] is not None
+
+
+# -- round-11 observability endpoints (obs/) ----------------------------------
+
+
+def test_trace_endpoints(server):
+    """GET /trace is 404 while tracing is disabled; with a recorder
+    installed, a solve is reconstructible: /trace lists recent spans,
+    /trace/<uuid> returns the job's lifecycle, and ?format=perfetto
+    exports Chrome-trace JSON that passes the traceck validator."""
+    from distributed_sudoku_solver_tpu.obs import trace, traceck
+
+    status, body = _request(server, "/trace")
+    assert status == 404 and "tracing disabled" in body["error"]
+
+    rec = trace.TraceRecorder(ring=2048)
+    with trace.installed(rec):
+        status, _ = _request(
+            server, "/solve", {"sudoku": np.asarray(EASY_9).tolist()}
+        )
+        assert status == 201
+        status, body = _request(server, "/trace")
+        assert status == 200 and body["count"] >= 1
+        http_spans = [s for s in body["spans"] if s["name"] == "http.solve"]
+        assert http_spans, "no http.solve span in the ring"
+        assert http_spans[-1]["attrs"]["status"] == 201
+        uuid = http_spans[-1]["trace"]
+
+        status, body = _request(server, f"/trace/{uuid}")
+        assert status == 200 and body["uuid"] == uuid
+        names = {s["name"] for s in body["spans"]}
+        # HTTP accept -> admission -> chunk work -> resolution: one trace.
+        assert {"http.solve", "admission", "resolve"} <= names, names
+
+        status, doc = _request(server, "/trace?format=perfetto")
+        assert status == 200
+        assert traceck.check(doc) == []
+        status, _ = _request(server, "/trace?limit=zzz")
+        assert status == 400
+    status, _ = _request(server, "/trace")
+    assert status == 404  # uninstalled again
+
+
+def test_metrics_prometheus_exposition(server):
+    import urllib.request as _rq
+
+    raw = (
+        _rq.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics?format=prometheus",
+            timeout=30,
+        )
+        .read()
+        .decode()
+    )
+    lines = [ln for ln in raw.splitlines() if ln]
+    assert lines and all(ln.startswith("dsst_") for ln in lines)
+    assert any(ln.startswith("dsst_jobs_done ") for ln in lines)
+    # String leaves (device info) render info-style: label on a 1 gauge.
+    assert any(ln.startswith("dsst_device_platform{") for ln in lines)
+    # The JSON form still serves (query param, not a breaking change).
+    status, body = _request(server, "/metrics")
+    assert status == 200 and "jobs_done" in body
+
+
+def test_profile_window_endpoint(server, tmp_path):
+    """POST /profile: a bounded jax.profiler window — 200 with the logdir,
+    400 on a bad body, and self-closing so the node is never left tracing."""
+    import os as _os
+    import time as _time
+
+    from distributed_sudoku_solver_tpu.utils import profiling
+
+    status, _ = _request(server, "/profile", {"secs": -1})
+    assert status == 400
+    status, body = _request(
+        server, "/profile", {"secs": 0.2, "logdir": str(tmp_path / "prof")}
+    )
+    assert status == 200
+    assert body["secs"] == 0.2
+    # Wait out the window so later tests see a closed profiler.
+    deadline = _time.monotonic() + 15.0
+    while profiling.profile_window_active() and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    assert not profiling.profile_window_active()
+    # The capture directory exists once the window closed (jax writes the
+    # trace data at stop time).
+    assert _os.path.isdir(body["logdir"])
+
+
+def test_access_log_opt_in(server, caplog):
+    """Satellite: access logging routes through `logging` and is opt-in —
+    silent by default, one INFO record per request when enabled."""
+    import logging as _logging
+
+    with caplog.at_level(_logging.INFO, logger="distributed_sudoku_solver_tpu.serving.http.access"):
+        _request(server, "/stats")
+        assert not [
+            r for r in caplog.records if r.name.endswith("http.access")
+        ], "access log must be opt-in"
+        server.httpd.access_log = True
+        try:
+            _request(server, "/stats")
+        finally:
+            server.httpd.access_log = False
+    access = [r for r in caplog.records if r.name.endswith("http.access")]
+    assert access and "GET /stats" in access[-1].getMessage()

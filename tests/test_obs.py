@@ -1,0 +1,435 @@
+"""Round-11 observability plane: flight-recorder tracing (obs/trace.py),
+Prometheus exposition (obs/prom.py), the traceck validator, and log/uuid
+correlation.
+
+Three layers of assertions:
+
+* **Recorder unit lane** — ring bound, link/resolve aliasing, per-uuid
+  queries (primary id AND multi-job ``uuids`` attribution), ingest
+  idempotence, Perfetto export validity, dump files.
+* **Engine e2e lane** — a traced solve produces the full lifecycle
+  (admission -> chunk dispatch/sync -> resolve), the disabled path records
+  NOTHING (the zero-allocation guard-branch microcheck), and failure logs
+  carry the job uuid.
+* **Simnet acceptance** — a cluster solve with an injected permanent
+  fault yields a stitched multi-node trace for the job's uuid, a
+  flight-recorder dump containing the fault span, and Perfetto output
+  that passes traceck — all on the virtual clock, no sleeps (the simnet
+  marker guard enforces it).
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.obs import prom, trace, traceck
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.serving import faults
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.faults import (
+    FaultInjector,
+    FaultSchedule,
+)
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+SMALL = SolverConfig(min_lanes=8, stack_slots=16)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    """Every test here must leave the process-wide seam clean."""
+    yield
+    trace.install(None)
+
+
+# -- recorder unit lane --------------------------------------------------------
+
+
+def test_ring_is_bounded_and_ordered():
+    rec = trace.TraceRecorder(ring=32, clock=lambda: 1.0)
+    for i in range(100):
+        rec.event(f"u{i}", "e", "site")
+    spans = rec.spans()
+    assert len(spans) == 32
+    assert spans[0]["trace"] == "u68" and spans[-1]["trace"] == "u99"
+
+
+def test_link_resolve_and_uuid_attribution():
+    t = 0.0
+    rec = trace.TraceRecorder(clock=lambda: t)
+    rec.link("root#p1", "root")
+    rec.event("root", "resolve", "engine.resolve")
+    rec.event("root#p1", "recv.SUBTASK", "cluster.recv")
+    # A flight-level span attributes via its uuids list, not a primary id.
+    rec.record(None, "chunk.dispatch", "engine.advance", 0.0,
+               uuids=["root#p1", "other"])
+    rec.event("other", "resolve", "engine.resolve")
+    got = {s["name"] for s in rec.spans("root")}
+    assert got == {"resolve", "recv.SUBTASK", "chunk.dispatch"}
+    assert rec.resolve("root#p1") == "root"
+    # Self-links and unknown uuids are harmless.
+    rec.link("x", "x")
+    assert rec.resolve("never-seen") == "never-seen"
+
+
+def test_ingest_is_idempotent_and_defensive():
+    rec = trace.TraceRecorder(clock=lambda: 0.0)
+    span = rec.event("u1", "resolve", "engine.resolve")
+    # Re-ingesting a span this recorder produced is a no-op (shared
+    # recorder in the simnet lane); a genuinely remote span lands once.
+    assert rec.ingest([dict(span)]) == 0
+    remote = {
+        "id": "peer/1", "trace": "u1", "name": "recv.TASK",
+        "site": "cluster.recv", "t0": 0.0, "t1": 0.0, "node": "peer",
+        "uuids": [], "attrs": {},
+    }
+    assert rec.ingest([dict(remote), dict(remote)]) == 1
+    assert rec.remote_spans_ingested == 1
+    # Garbage from the wire must be skipped, never raise.
+    assert rec.ingest([None, 7, {"id": "x"}, {"no": "fields"}]) == 0
+    assert rec.ingest("not a list") == 0
+    assert len(rec.spans("u1")) == 2
+
+
+def test_ingested_part_spans_resolve_into_root_trace():
+    """Per-process recorders (any real cluster): the peer's spans for a
+    shed part arrive with trace = the PART uuid and the peer's link table
+    never crosses the wire — the shedder records the part->root link
+    itself (_on_needwork / _on_part_result), so ingested part spans land
+    in the root's stitched trace (review finding, round 11)."""
+    rec = trace.TraceRecorder(clock=lambda: 0.0)
+    rec.link("root#p1", "root")  # what the shedder records at shed time
+    remote = {
+        "id": "peer/9", "trace": "root#p1", "name": "resolve",
+        "site": "engine.resolve", "t0": 0.0, "t1": 0.0, "node": "peer",
+        "uuids": [], "attrs": {},
+    }
+    assert rec.ingest([remote]) == 1
+    assert any(s["id"] == "peer/9" for s in rec.spans("root")), (
+        "ingested part span missing from the root trace"
+    )
+
+
+def test_perfetto_export_passes_traceck_and_is_json():
+    t = [0.0]
+    rec = trace.TraceRecorder(clock=lambda: t[0])
+    for i in range(5):
+        t[0] = float(i)
+        rec.record("u", f"s{i}", "engine.advance", float(i) - 0.5,
+                   node=f"n{i % 2}")
+    doc = rec.perfetto()
+    assert traceck.check(doc) == []
+    json.dumps(doc)  # JSON-native end to end
+    # Two nodes -> two pids with process_name metadata.
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) == 2
+
+
+def test_traceck_rejects_malformed_documents():
+    assert traceck.check([]) != []
+    assert traceck.check({}) != []
+    bad_ph = {"traceEvents": [{"name": "x", "ph": "Q", "pid": 1, "tid": 1}]}
+    assert any("ph" in e for e in traceck.check(bad_ph))
+    neg_dur = {"traceEvents": [
+        {"name": "x", "ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": -5}
+    ]}
+    assert any("dur" in e for e in traceck.check(neg_dur))
+    non_mono = {"traceEvents": [
+        {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 10, "dur": 1},
+        {"name": "b", "ph": "X", "pid": 1, "tid": 1, "ts": 5, "dur": 1},
+    ]}
+    assert any("monotone" in e for e in traceck.check(non_mono))
+
+
+def test_traceck_cli_roundtrip(tmp_path):
+    rec = trace.TraceRecorder(clock=lambda: 0.0)
+    rec.event("u", "e", "s")
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(rec.perfetto()))
+    assert traceck.main([str(good)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    assert traceck.main([str(bad)]) == 1
+    assert traceck.check_file(str(tmp_path / "missing.json")) != []
+
+
+def test_flight_recorder_dump_file(tmp_path):
+    rec = trace.TraceRecorder(clock=lambda: 3.0, dump_dir=str(tmp_path),
+                              dump_spans=2)
+    for i in range(5):
+        rec.event(f"u{i}", "e", "s")
+    path = rec.dump("unit", metrics={"jobs_done": 1})
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "unit"
+    assert len(doc["spans"]) == 2  # last dump_spans only
+    assert doc["metrics"] == {"jobs_done": 1}
+    assert rec.dumps == 1
+    # No dump_dir -> disabled, never raises.
+    assert trace.TraceRecorder().dump("x") is None
+
+
+# -- engine e2e lane -----------------------------------------------------------
+
+
+def test_traced_solve_records_full_lifecycle():
+    rec = trace.TraceRecorder(ring=4096)
+    with trace.installed(rec):
+        eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=2).start()
+        try:
+            j = eng.submit(HARD_9[1])
+            assert j.wait(120) and j.solved, j.error
+            m = eng.metrics()
+        finally:
+            eng.stop(timeout=2)
+    names = {s["name"] for s in rec.spans(j.uuid)}
+    assert {"admission", "chunk.dispatch", "resolve"} <= names, names
+    adm = next(s for s in rec.spans(j.uuid) if s["name"] == "admission")
+    assert adm["attrs"]["route"] == "static"
+    assert adm["t1"] >= adm["t0"]  # the queue wait, on the recorder clock
+    res = next(s for s in rec.spans(j.uuid) if s["name"] == "resolve")
+    assert res["attrs"]["solved"] is True
+    # Chunk spans ride the fault plane's site vocabulary.
+    sites = {s["site"] for s in rec.spans(j.uuid)}
+    assert "engine.advance" in sites
+    # /metrics exposes recorder health while installed.
+    assert m["trace"]["spans"] >= 3
+
+
+def test_disabled_tracing_guard_branch_records_nothing(monkeypatch):
+    """The zero-overhead microcheck: with no recorder installed, the
+    instrumented hot loops must never construct or record a span — the
+    guard is `trace.active() is None` and every allocation (uuid lists,
+    clock reads, span dicts) lives behind it.  Monkeypatching the
+    recording surface to explode proves the branch is never entered."""
+    assert trace.active() is None
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("span recorded while tracing is disabled")
+
+    monkeypatch.setattr(trace.TraceRecorder, "record", boom)
+    monkeypatch.setattr(trace.TraceRecorder, "event", boom)
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=2).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+        assert j.trace_t0 is None  # not even the submit-time stamp
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_job_failure_logs_carry_uuid(caplog):
+    """Log-correlation satellite: records about a failed job name its
+    uuid, so a trace/HTTP uuid greps straight to the log evidence."""
+    inj = FaultInjector(
+        schedule=FaultSchedule.at({"engine.launch": {0: "permanent"}})
+    )
+    with faults.injected(inj):
+        eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=2).start()
+        try:
+            with caplog.at_level(logging.ERROR):
+                j = eng.submit(EASY_9)
+                assert j.wait(120)
+                assert j.error and "[permanent]" in j.error
+        finally:
+            eng.stop(timeout=2)
+    assert any(
+        j.uuid in r.getMessage() for r in caplog.records
+    ), "no log record carries the failed job's uuid"
+
+
+def test_breaker_open_transition_traces_and_dumps(tmp_path):
+    """The other flight-recorder moment: consecutive resident rebuild
+    failures drive the breaker open — the transition is a trace event and
+    triggers an automatic dump (host-side only: the flight never touches
+    the device here)."""
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.serving.scheduler import ResidentConfig
+
+    t = [0.0]
+    rec = trace.TraceRecorder(
+        clock=lambda: t[0], dump_dir=str(tmp_path)
+    )
+    with trace.installed(rec):
+        eng = SolverEngine(
+            config=SMALL,
+            resident=ResidentConfig(job_slots=2, gang_lanes=4),
+            recovery=faults.RecoveryPolicy(
+                breaker_failures=2, clock=lambda: t[0]
+            ),
+        )
+        rf = eng._resident_for(SUDOKU_9)
+        assert rf is not None
+        rf.on_failure(RuntimeError("UNAVAILABLE: preempted (simulated)"))
+        assert rf.breaker.state == rf.breaker.CLOSED
+        rf.on_failure(RuntimeError("UNAVAILABLE: preempted (simulated)"))
+        assert rf.breaker.state == rf.breaker.OPEN
+    transitions = [s for s in rec.spans() if s["name"] == "breaker"]
+    assert transitions and transitions[-1]["attrs"]["to"] == "open"
+    dumps = [f for f in os.listdir(tmp_path) if "breaker_open" in f]
+    assert dumps, "breaker-open transition must write a flight-recorder dump"
+    doc = json.loads((tmp_path / dumps[0]).read_text())
+    assert doc["reason"] == "breaker_open"
+    assert doc["metrics"]["resident"]["9x9"]["faults"]["rebuilds"] >= 1
+
+
+# -- prometheus exposition -----------------------------------------------------
+
+# A fixed metrics-shaped dict covering every flattening rule: nested
+# windows, geometry dicts, method-label dicts, string leaves (breaker
+# state, device info), numeric lists (histogram buckets, the view pair),
+# bools, and skipped None/empty values.
+PROM_SAMPLE = {
+    "jobs_done": 42,
+    "solved": 40,
+    "job_latency_ms": {"count": 10, "p50": 1.5, "p95": 20.25},
+    "resident": {
+        "9x9": {"occupied": 3, "queued": 0},
+        "16x16": {"occupied": 1, "queued": 2},
+    },
+    "faults": {
+        "retries": 7,
+        "breaker": {"9x9": {"state": "half_open", "transitions": 3}},
+    },
+    "cluster": {
+        "address": "10.0.0.1:7000",
+        "view": [1, 4],
+        "faults": {"duplicates_dropped": {"SOLUTION": 2, "TASK": 1}},
+    },
+    "fused_lane_occupancy": {"counts": [5, 0, 9], "mean_pct": 61.5},
+    "device": {"kind": "cpu", "platform": "cpu"},
+    "healthy": True,
+    "nothing": None,
+    "empty": {},
+}
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "prometheus_golden.txt")
+
+
+def test_prometheus_render_matches_golden_file():
+    got = prom.render(PROM_SAMPLE)
+    want = open(GOLDEN).read()
+    assert got == want, (
+        "prometheus exposition drifted from the golden file; if the change "
+        "is deliberate, regenerate tests/data/prometheus_golden.txt"
+    )
+
+
+def test_prometheus_render_escapes_and_shapes():
+    out = prom.render({"s": 'a"b\\c\nd', "n": 1.25})
+    assert 'dsst_s{s="a\\"b\\\\c\\nd"} 1' in out
+    assert "dsst_n 1.25" in out
+    assert out.endswith("\n")
+    assert prom.render({}) == ""
+
+
+# -- simnet acceptance ---------------------------------------------------------
+
+
+@pytest.mark.simnet
+def test_cluster_trace_stitching_fault_dump_and_perfetto(tmp_path):
+    """Acceptance: a simnet cluster solve with an injected PERMANENT fault
+    produces (1) a stitched multi-node trace for the job's uuid — spans
+    recorded by both the origin and the worker, trace context having
+    ridden the TASK/SOLUTION frames; (2) an automatic flight-recorder
+    dump containing the fault span; (3) Perfetto export that passes the
+    traceck validator.  Everything timestamps through the simnet virtual
+    clock (the recorder's injected clock), and the simnet marker guard
+    proves no sleeps/sockets."""
+    from distributed_sudoku_solver_tpu.cluster.node import (
+        ClusterConfig,
+        ClusterNode,
+    )
+    from distributed_sudoku_solver_tpu.cluster.simnet import SimNet, wait_until
+
+    from tests.test_cluster import oracle_solve_fn
+
+    cfg = ClusterConfig(
+        heartbeat_s=0.25, fail_factor=8.0, io_timeout_s=2.0, needwork=False,
+        progress_interval_s=0.0, retry_delay_s=0.1, tombstone_probe_s=600.0,
+    )
+    net = SimNet()
+    rec = trace.TraceRecorder(
+        ring=8192, clock=net.clock.now, node="driver", dump_dir=str(tmp_path)
+    )
+    # engine.launch #0 is the worker's first (and only) flight launch:
+    # the poison dispatch a retry cannot cure.
+    inj = FaultInjector(
+        schedule=FaultSchedule.at({"engine.launch": {0: "permanent"}})
+    )
+    ea = eb = a = b = None
+    try:
+        with trace.installed(rec), faults.injected(inj):
+            ea = SolverEngine(
+                solve_fn=oracle_solve_fn(), batch_window_s=0.001
+            ).start()
+            eb = SolverEngine(
+                config=SolverConfig(min_lanes=4, stack_slots=32, branch="first"),
+                chunk_steps=1,
+                batch_window_s=0.001,
+            ).start()
+            a = ClusterNode(ea, config=cfg, transport=net.transport(),
+                            clock=net.clock).start()
+            b = ClusterNode(eb, anchor=a.addr, config=cfg,
+                            transport=net.transport(), clock=net.clock).start()
+            assert wait_until(
+                net, lambda: len(a.network) == 2 and len(b.network) == 2,
+                timeout=60,
+            ), "ring never formed"
+            job = a._submit_remote(np.asarray(EASY_9, np.int32), b.addr_s)
+            assert wait_until(net, lambda: job.done.is_set(), timeout=240), (
+                "remote job never resolved"
+            )
+            assert job.error and "[permanent]" in job.error
+
+            # (1) Stitched multi-node trace: the one uuid reconstructs the
+            # whole distributed story, each span tagged with its recorder.
+            spans = rec.spans(job.uuid)
+            names = {s["name"] for s in spans}
+            assert {"send.TASK", "recv.TASK", "admission",
+                    "fault.permanent", "send.SOLUTION",
+                    "recv.SOLUTION"} <= names, names
+            span_nodes = {s["node"] for s in spans}
+            assert {a.addr_s, b.addr_s} <= span_nodes, (
+                f"trace not stitched across nodes: {span_nodes}"
+            )
+            # Timestamps ride the virtual clock: nothing precedes t=0 and
+            # every span is monotone.
+            assert all(0.0 <= s["t0"] <= s["t1"] for s in spans)
+
+            # (2) The flight-recorder dump fired on the permanent fault
+            # and holds the fault span for this uuid.  The dump is written
+            # on the worker's device loop, concurrently with the SOLUTION
+            # round-trip that resolved the handle — wait for the file, on
+            # the virtual clock (wait_until yields real scheduler slices).
+            assert wait_until(
+                net,
+                lambda: any(
+                    f.endswith("permanent_fault.json")
+                    for f in os.listdir(tmp_path)
+                ),
+                timeout=60,
+            ), "no flight-recorder dump on the permanent fault"
+            dumps = [f for f in os.listdir(tmp_path)
+                     if f.endswith("permanent_fault.json")]
+            doc = json.loads((tmp_path / dumps[0]).read_text())
+            assert any(
+                s["name"] == "fault.permanent" and s["trace"] == job.uuid
+                for s in doc["spans"]
+            )
+            assert doc["metrics"]["faults"]["permanent_failures"] >= 1
+
+            # (3) GET /trace?format=perfetto serves exactly this payload
+            # (serving/http.py delegates to rec.perfetto()): it must pass
+            # the traceck validator.
+            assert traceck.check(rec.perfetto()) == []
+    finally:
+        for n in (a, b):
+            if n is not None:
+                n.kill()
+        for e in (ea, eb):
+            if e is not None:
+                e.stop(timeout=1)
+        net.close()

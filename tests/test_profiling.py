@@ -1,0 +1,157 @@
+"""utils/profiling.py coverage (round-11 satellites): StatWindow edge
+cases that were never pinned (empty / single-sample / wraparound /
+concurrent torn-window tolerance), the narrowed ``stop_trace`` swallow,
+and the bounded serving profile window behind ``POST /profile``."""
+
+import logging
+import threading
+import time
+
+import pytest
+
+from distributed_sudoku_solver_tpu.utils import profiling
+from distributed_sudoku_solver_tpu.utils.profiling import StatWindow
+
+
+# -- StatWindow ----------------------------------------------------------------
+
+
+def test_statwindow_empty_and_single_sample():
+    w = StatWindow(capacity=8)
+    assert w.snapshot() is None
+    w.record(5.0)
+    snap = w.snapshot()
+    assert snap["count"] == 1 and snap["total"] == 1
+    # One sample: every percentile IS that sample.
+    assert snap["p50"] == snap["p95"] == snap["p99"] == 5.0
+
+
+def test_statwindow_capacity_plus_one_wraparound():
+    """capacity+1 records: the ring holds exactly the last `capacity`
+    values (the oldest was overwritten), and percentiles read the window
+    content, not stale slots."""
+    w = StatWindow(capacity=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+        w.record(v)
+    snap = w.snapshot()
+    assert snap["count"] == 4 and snap["total"] == 5
+    # Window = {2, 3, 4, 5}: the evicted 1.0 must not drag p50 down, and
+    # p99 must not exceed the maximum surviving sample.
+    assert 3.0 <= snap["p50"] <= 4.0
+    assert snap["p99"] <= 5.0
+    assert snap["p50"] >= 2.0
+
+
+def test_statwindow_full_wraparound_correctness():
+    """Many wraps: the window is exactly the last `capacity` samples."""
+    w = StatWindow(capacity=8)
+    for v in range(1, 101):
+        w.record(float(v))
+    snap = w.snapshot()
+    assert snap["count"] == 8 and snap["total"] == 100
+    # Survivors are 93..100.
+    assert 93.0 <= snap["p50"] <= 100.0
+    assert snap["p99"] <= 100.0
+    assert snap["p95"] >= snap["p50"] >= 93.0
+
+
+def test_statwindow_concurrent_writer_reader_torn_window():
+    """The documented contract: a reader racing the writer gets a
+    consistent-enough snapshot — never an exception, never a value outside
+    the recorded range (every slot always holds a recorded value or the
+    initial 0.0 before the window fills, and count never exceeds
+    capacity)."""
+    w = StatWindow(capacity=64)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        v = 0
+        while not stop.is_set():
+            w.record((v % 100) / 100.0)  # all values in [0, 1)
+            v += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                snap = w.snapshot()
+                if snap is None:
+                    continue
+                assert 1 <= snap["count"] <= 64
+                assert 0.0 <= snap["p50"] <= 1.0
+                assert 0.0 <= snap["p99"] <= 1.0
+                assert snap["total"] >= snap["count"]
+        except Exception as e:  # noqa: BLE001 - recorded for the assert below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    assert not errors, errors
+
+
+# -- device_trace stop swallow (satellite fix) ---------------------------------
+
+
+def test_device_trace_swallows_only_already_stopped(tmp_path, caplog):
+    """The documented race — the bounded window timer stopped the trace
+    first — stays silent; any OTHER stop_trace failure is logged instead
+    of hidden (the pre-round-11 bare `except RuntimeError: pass`)."""
+    import jax
+
+    from distributed_sudoku_solver_tpu.utils.profiling import device_trace
+
+    with caplog.at_level(logging.ERROR):
+        with device_trace(str(tmp_path / "t1")):
+            jax.profiler.stop_trace()  # the window timer fired "early"
+    assert not caplog.records, "already-stopped case must stay silent"
+
+
+def test_device_trace_logs_real_stop_failures(tmp_path, caplog, monkeypatch):
+    import jax
+
+    from distributed_sudoku_solver_tpu.utils.profiling import device_trace
+
+    real_stop = jax.profiler.stop_trace
+    with caplog.at_level(logging.ERROR):
+        with device_trace(str(tmp_path / "t2")):
+            monkeypatch.setattr(
+                jax.profiler,
+                "stop_trace",
+                lambda: (_ for _ in ()).throw(
+                    RuntimeError("trace export failed: disk full")
+                ),
+            )
+    assert any("stop_trace failed" in r.getMessage() for r in caplog.records)
+    monkeypatch.setattr(jax.profiler, "stop_trace", real_stop)
+    real_stop()  # the real session is still open: close it for later tests
+
+
+# -- the bounded profile window (POST /profile backend) ------------------------
+
+
+def test_profile_window_is_exclusive_and_self_closing(tmp_path):
+    assert not profiling.profile_window_active()
+    assert profiling.start_profile_window(str(tmp_path / "w1"), 0.2) is True
+    assert profiling.profile_window_active()
+    # Exclusive while open.
+    assert profiling.start_profile_window(str(tmp_path / "w2"), 0.2) is False
+    # Self-closing: the daemon timer stops the trace without a second call.
+    deadline = time.monotonic() + 10.0
+    while profiling.profile_window_active() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not profiling.profile_window_active(), "window never self-closed"
+    # Reusable after close.
+    assert profiling.start_profile_window(str(tmp_path / "w3"), 0.1) is True
+    deadline = time.monotonic() + 10.0
+    while profiling.profile_window_active() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not profiling.profile_window_active()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))

@@ -284,7 +284,26 @@ def counted_fetches(monkeypatch):
     return calls
 
 
-def test_static_loop_exactly_one_sync_per_chunk(counted_fetches):
+@pytest.fixture(params=[False, True], ids=["untraced", "traced"])
+def tracing(request):
+    """Run the sync-count guards both ways: the round-11 trace plane
+    (obs/trace.py) promises ZERO host syncs — every span is built from
+    values the loop already holds — so the one-sync-per-chunk contract
+    must hold bit-identically with a recorder installed."""
+    if not request.param:
+        yield None
+        return
+    from distributed_sudoku_solver_tpu.obs import trace
+
+    rec = trace.TraceRecorder(ring=8192)
+    trace.install(rec)
+    try:
+        yield rec
+    finally:
+        trace.install(None)
+
+
+def test_static_loop_exactly_one_sync_per_chunk(counted_fetches, tracing):
     """A multi-chunk single-job static flight: every consumed chunk costs
     exactly one 'status' fetch; the only other sync is the terminal
     finalize.  A stray value read added to the hot loop shows up as an
@@ -306,9 +325,15 @@ def test_static_loop_exactly_one_sync_per_chunk(counted_fetches):
     # A 1-job flight resolves at finalize, never mid-flight: no event
     # fetches, and nothing else in the loop may sync at all.
     assert len(counted_fetches) == statuses + finalizes, counted_fetches
+    if tracing is not None:
+        # The trace plane really recorded the chunks it claims cost no
+        # syncs (an empty ring would make the traced run vacuous).
+        names = [s["name"] for s in tracing.spans()]
+        assert names.count("chunk.sync") == statuses
+        assert "resolve" in names
 
 
-def test_resident_loop_exactly_one_sync_per_chunk(counted_fetches):
+def test_resident_loop_exactly_one_sync_per_chunk(counted_fetches, tracing):
     """The resident scheduler round: one 'status' fetch per consumed
     chunk, one 'event' fetch on the single round where the tenant's
     verdict is collected, and no terminal finalize (the frontier never
@@ -331,6 +356,13 @@ def test_resident_loop_exactly_one_sync_per_chunk(counted_fetches):
     assert events == 1, "exactly one verdict collection for one tenant"
     assert counted_fetches.count("finalize") == 0
     assert len(counted_fetches) == statuses + events, counted_fetches
+    if tracing is not None:
+        names = [s["name"] for s in tracing.spans()]
+        assert names.count("resident.sync") == statuses
+        assert names.count("verdict.sync") == events
+        # The admission span carries the resident route attribution.
+        adm = [s for s in tracing.spans() if s["name"] == "admission"]
+        assert adm and adm[0]["attrs"]["route"] == "resident"
 
 
 # -- padded-bucket job dimension (flight frontiers pad to a power of two) -----
