@@ -150,8 +150,14 @@ def compare_poisson(
         lats, jobs = poisson_load(resident, boards, mean_gap_s, seed)
         assert all(j.solved for j in jobs), "resident engine failed a job"
         out["resident"] = _percentiles(lats)
-        rm = resident.metrics()["resident"]["9x9"]
+        m_full = resident.metrics()
+        rm = m_full["resident"]["9x9"]
         out["resident_metrics"] = rm
+        # Normalized-artifact fields (--out-json / benchmarks/regress.py):
+        # the phase histograms (mergeable obs/hist.py dicts) and the live
+        # rpc_floor estimate from the run's chunk.sync samples.
+        out["hist"] = m_full.get("hist")
+        out["rpc_floor_ms"] = m_full.get("rpc_floor_ms")
         # The resident flight's own overlap split: chunk_wall_ms IS its
         # per-round status-sync wall; dispatch_wall_ms its async enqueues.
         out["resident_walls"] = {
@@ -191,6 +197,13 @@ def main() -> None:
         "(open in Perfetto; validate with "
         "`python -m distributed_sudoku_solver_tpu.obs.traceck <file>`)",
     )
+    ap.add_argument(
+        "--out-json",
+        default=None,
+        help="write a normalized result artifact (p50/p95 per engine, "
+        "rpc_floor estimate, phase histograms) for "
+        "benchmarks/regress.py — the bench-trajectory gate",
+    )
     args = ap.parse_args()
 
     rec = None
@@ -220,6 +233,30 @@ def main() -> None:
                 f"({len(doc['traceEvents'])} events)",
                 file=sys.stderr,
             )
+    if args.out_json:
+        artifact = {
+            # Versioned so regress.py can refuse cross-schema compares.
+            "schema": "dsst-bench-poisson/1",
+            "params": {
+                "jobs": args.jobs,
+                "mean_gap_ms": args.mean_ms,
+                "handicap_ms": args.handicap_ms,
+                "chunk_steps": args.chunk_steps,
+                "seed": args.seed,
+            },
+            "static": out["static"],
+            "resident": out["resident"],
+            "speedups": {
+                q: out.get(f"speedup_{q}") for q in ("p50", "p95", "p99")
+            },
+            "rpc_floor_ms": out.get("rpc_floor_ms"),
+            "hist": out.get("hist"),
+        }
+        tmp = args.out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f)
+        os.replace(tmp, args.out_json)  # atomic like the flight recorder
+        print(f"artifact written: {args.out_json}", file=sys.stderr)
     if args.json:
         print(json.dumps(out))
         return

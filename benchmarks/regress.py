@@ -1,0 +1,144 @@
+"""Benchmark regression gate: compare two bench_poisson artifacts.
+
+The first hook of the bench trajectory::
+
+    python benchmarks/bench_poisson.py --jobs 48 --out-json base.json
+    # ... change the code ...
+    python benchmarks/bench_poisson.py --jobs 48 --out-json new.json
+    python benchmarks/regress.py base.json new.json [--tol 0.25]
+
+Exit codes: **0** no regression, **1** regression (some p50/p95 degraded
+past the noise tolerance), **2** the artifacts are not comparable
+(schema/params mismatch, unreadable files).
+
+The comparison is deliberately coarse: per engine (static / resident),
+``p50_ms`` and ``p95_ms`` must satisfy ``new <= old * (1 + tol)``.  The
+default tolerance (25%) reflects the CPU container's measured run-to-run
+variance (BENCHMARKS.md round-8 3-run note); tighten it on quiet
+hardware.  The rpc_floor estimate is *reported*, not gated — the floor
+is a property of the link, and a changed floor means the environments
+differ, which the report should say out loud rather than fail on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Union
+
+SCHEMA = "dsst-bench-poisson/1"
+SIDES = ("static", "resident")
+QUANTS = ("p50_ms", "p95_ms")
+
+
+def _load(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f), None
+    except (OSError, json.JSONDecodeError) as e:
+        return None, f"{path}: unreadable or not JSON: {e}"
+
+
+def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
+    """-> {"comparable": bool, "errors": [...], "regressions": [...],
+    "improvements": [...], "notes": [...]}.  ``regressions`` non-empty is
+    the gate failure."""
+    errors: List[str] = []
+    for name, doc in (("old", old), ("new", new)):
+        if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+            errors.append(
+                f"{name} artifact has schema "
+                f"{doc.get('schema') if isinstance(doc, dict) else doc!r}, "
+                f"expected {SCHEMA}"
+            )
+    if not errors and old.get("params") != new.get("params"):
+        errors.append(
+            "artifacts measured different workloads: "
+            f"params {old.get('params')} vs {new.get('params')} — "
+            "re-run both sides with identical flags"
+        )
+    if errors:
+        return {
+            "comparable": False,
+            "errors": errors,
+            "regressions": [],
+            "improvements": [],
+            "notes": [],
+        }
+    regressions: List[str] = []
+    improvements: List[str] = []
+    notes: List[str] = []
+    for side in SIDES:
+        for q in QUANTS:
+            o = float(old[side][q])
+            n = float(new[side][q])
+            limit = o * (1.0 + tol)
+            if n > limit:
+                regressions.append(
+                    f"{side} {q}: {o:.1f} -> {n:.1f} ms "
+                    f"(+{(n / o - 1) * 100:.0f}%, tolerance {tol * 100:.0f}%)"
+                )
+            elif n < o * (1.0 - tol):
+                improvements.append(
+                    f"{side} {q}: {o:.1f} -> {n:.1f} ms "
+                    f"({(n / o - 1) * 100:.0f}%)"
+                )
+    of, nf = old.get("rpc_floor_ms"), new.get("rpc_floor_ms")
+    if isinstance(of, dict) and isinstance(nf, dict):
+        o_min, n_min = float(of.get("min", 0)), float(nf.get("min", 0))
+        if o_min > 0 and abs(n_min - o_min) > tol * o_min:
+            notes.append(
+                f"rpc_floor_ms moved {o_min:.2f} -> {n_min:.2f}: the "
+                "environments' sync floors differ — latency deltas may "
+                "be the link, not the code"
+            )
+    return {
+        "comparable": True,
+        "errors": [],
+        "regressions": regressions,
+        "improvements": improvements,
+        "notes": notes,
+    }
+
+
+def main(argv: Union[List[str], None] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline artifact (bench_poisson --out-json)")
+    ap.add_argument("new", help="candidate artifact")
+    ap.add_argument(
+        "--tol",
+        type=float,
+        default=0.25,
+        help="noise tolerance as a fraction (default 0.25 = 25%%)",
+    )
+    args = ap.parse_args(argv)
+    old, err_o = _load(args.old)
+    new, err_n = _load(args.new)
+    for err in (err_o, err_n):
+        if err:
+            print(f"regress: {err}", file=sys.stderr)
+    if err_o or err_n:
+        return 2
+    rep = compare(old, new, tol=args.tol)
+    if not rep["comparable"]:
+        for e in rep["errors"]:
+            print(f"regress: {e}", file=sys.stderr)
+        return 2
+    for line in rep["notes"]:
+        print(f"regress: note: {line}")
+    for line in rep["improvements"]:
+        print(f"regress: improved: {line}")
+    if rep["regressions"]:
+        for line in rep["regressions"]:
+            print(f"regress: REGRESSION: {line}", file=sys.stderr)
+        return 1
+    print(
+        f"regress: OK — no regression beyond {args.tol * 100:.0f}% "
+        f"({', '.join(f'{s} {q}' for s in SIDES for q in QUANTS)})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,6 +152,30 @@ def build_parser() -> argparse.ArgumentParser:
         "(default: <tmpdir>/dsst-flightrec when --trace is on)",
     )
     ap.add_argument(
+        "--slo",
+        type=str,
+        default=None,
+        help="declarative service-level objectives (obs/slo.py), e.g. "
+        '--slo "solve_p95_ms<=250,error_rate<=0.01" — windowed error-'
+        "budget burn rates surface on GET /slo and /metrics, and a "
+        "burn-rate threshold crossing triggers a flight-recorder dump "
+        "(when --trace is on)",
+    )
+    ap.add_argument(
+        "--slo-window",
+        type=float,
+        default=60.0,
+        help="sliding window (seconds) for SLO burn-rate computation",
+    )
+    ap.add_argument(
+        "--slo-burn",
+        type=float,
+        default=1.0,
+        help="burn-rate threshold that flips an objective to burning "
+        "(1.0 = consuming the error budget exactly at the sustained "
+        "allowable rate)",
+    )
+    ap.add_argument(
         "--access-log",
         action="store_true",
         help="log one INFO record per HTTP request (logger "
@@ -322,6 +346,18 @@ def main(argv=None) -> None:
                 or os.path.join(tempfile.gettempdir(), "dsst-flightrec"),
             )
         )
+    slo_monitor = None
+    if args.slo:
+        from distributed_sudoku_solver_tpu.obs import slo as slo_mod
+
+        # Parse before anything heavy boots: a typo in the grammar should
+        # fail the command, not a node an hour into serving.
+        slo_monitor = slo_mod.SloMonitor(
+            slo_mod.parse_slo(args.slo),
+            window_s=args.slo_window,
+            burn_threshold=args.slo_burn,
+        )
+        slo_mod.install(slo_monitor)
     trace = device_trace(args.profile_dir) if args.profile_dir else contextlib.nullcontext()
     with contextlib.ExitStack() as stack:
         # try/finally semantics: the trace survives any exit path.  A bounded
@@ -346,6 +382,10 @@ def main(argv=None) -> None:
             timer.start()
             stack.callback(timer.cancel)
         engine = make_engine(args).start()
+        if slo_monitor is not None:
+            # Burn dumps embed a metrics snapshot; injected here because
+            # obs/slo.py never imports the serving layer back.
+            slo_monitor.metrics_fn = engine.metrics
         node = ClusterNode(
             engine,
             host=args.host,

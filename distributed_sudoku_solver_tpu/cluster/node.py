@@ -54,8 +54,9 @@ import numpy as np
 from distributed_sudoku_solver_tpu.cluster import wire
 from distributed_sudoku_solver_tpu.cluster.wire import Addr, WireError, addr_str
 from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
-from distributed_sudoku_solver_tpu.obs import trace
-from distributed_sudoku_solver_tpu.obs.logctx import job_log
+from distributed_sudoku_solver_tpu.obs import agg, trace
+from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
+from distributed_sudoku_solver_tpu.obs.logctx import ctx_log, job_log
 from distributed_sudoku_solver_tpu.serving import faults
 from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
 
@@ -502,6 +503,16 @@ class ClusterNode:
         self.partitions_healed = 0
         self.demotions = 0
         self.rehomed_parts = 0
+        # Cluster-scope observability (round 12, obs/): the node's own
+        # mergeable wire-wall histograms (send = one egress through the
+        # transport; ack = a result-bearing send's full at-least-once
+        # round, retries included) — timed on the NODE clock, so the
+        # simnet lane's numbers are virtual and deterministic — plus the
+        # METRICS_PULL aggregation counters exported as cluster.agg.
+        self._hist = {"send_ms": LatencyHistogram(), "ack_ms": LatencyHistogram()}
+        self.agg_pulls = 0  # peer METRICS_PULL requests issued
+        self.agg_merges = 0  # cluster rollups computed
+        self.agg_unreachable = 0  # pulls that found a peer unreachable
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -590,7 +601,11 @@ class ClusterNode:
                     peer=peer if isinstance(peer, str) else addr_str(peer),
                 )
         addr = peer if isinstance(peer, tuple) else wire.parse_addr(peer)
+        t0 = self._clock.now()
         self._transport.send(addr, payload, self.config.io_timeout_s)
+        # Wire egress wall (mergeable, obs/hist.py): successful sends only
+        # — a failed send's wall measures the failure mode, not the link.
+        self._hist["send_ms"].record(self._clock.now() - t0)
 
     def _send_result(self, peer, payload: dict) -> bool:
         """At-least-once delivery for result-bearing messages (SOLUTION,
@@ -604,6 +619,7 @@ class ClusterNode:
         membership repair path (ledger re-execution, part re-homing) owns
         the work from here."""
         last: Optional[WireError] = None
+        t0 = self._clock.now()
         for attempt in range(self.config.send_retries + 1):
             if attempt:
                 self._clock.sleep(self.config.retry_delay_s)
@@ -611,6 +627,9 @@ class ClusterNode:
                     return False
             try:
                 self._send(peer, payload)
+                # The ack wall: first attempt -> delivered, retry pacing
+                # included — what a result actually pays to land.
+                self._hist["ack_ms"].record(self._clock.now() - t0)
                 return True
             except WireError as e:
                 last = e
@@ -780,6 +799,8 @@ class ClusterNode:
                 "validations": s["validations"],
                 "solved": s["solved"],
             }
+        elif method == "METRICS_PULL":
+            return self._on_metrics_pull(msg)
         else:
             _LOG.warning("[%s] unknown method %r", self.addr_s, method)
         return None
@@ -790,6 +811,34 @@ class ClusterNode:
                 self.duplicates_dropped.get(method, 0) + 1
             )
         _LOG.info("[%s] duplicate %s dropped", self.addr_s, method)
+
+    def _on_metrics_pull(self, msg: dict) -> dict:
+        """One member's half of ``GET /metrics?scope=cluster``: reply with
+        the local metrics body plus our view version — the puller marks
+        us ``stale`` when the versions disagree.  (term, epoch)-guarded
+        like HEARTBEAT: a pull asserting a strictly older term is counted
+        and gets our view reflected back (rate-limited) so a split-brain
+        survivor aggregating its losing ring learns the winner — but the
+        reply still carries honest data; staleness is the PULLER's flag
+        to surface, not a reason to go dark."""
+        term = msg.get("term")
+        sender = msg.get("from")
+        reflect_to = None
+        with self._lock:
+            if term is not None and int(term) < self.net_term:
+                self.stale_views_rejected += 1
+                if isinstance(sender, str) and ":" in sender:
+                    reflect_to = self._reflect_ok_locked(sender)
+            t, e = self.net_term, self.net_epoch
+        if reflect_to:
+            self._reflect_view(reflect_to)
+        return {
+            "method": "METRICS_RES",
+            "address": self.addr_s,
+            "term": t,
+            "epoch": e,
+            "metrics": self.metrics_view(),
+        }
 
     def _on_heartbeat(self, msg: dict) -> None:
         """A heartbeat refreshes the failure detector — unless its sender
@@ -1668,26 +1717,10 @@ class ClusterNode:
         total_v, total_s = s["validations"], s["solved"]
         with self._lock:
             peers = [m for m in self.network if m != self.addr_s]
-        results: list[Optional[dict]] = [None] * len(peers)
-
-        def ask(i: int, m: str) -> None:
-            try:
-                results[i] = self._transport.request(
-                    wire.parse_addr(m),
-                    {"method": "STATS_REQ"},
-                    self.config.stats_timeout_s,
-                )
-            except WireError:
-                results[i] = None
-
-        threads = [
-            threading.Thread(target=ask, args=(i, m), daemon=True)
-            for i, m in enumerate(peers)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(self.config.stats_timeout_s + 1.0)
+        results = wire.fanout_requests(
+            self._transport, peers, {"method": "STATS_REQ"},
+            self.config.stats_timeout_s,
+        )
         for m, res in zip(peers, results):
             if res is None:
                 nodes.append({"address": m, "validations": None})
@@ -1705,6 +1738,12 @@ class ClusterNode:
         and live local executions — the observability the reference's
         print-trace never had (SURVEY.md §5.5)."""
         body = self.engine.metrics()
+        # The node's own mergeable histograms (wire send/ack walls) join
+        # the engine's in one flat "hist" section, so cluster aggregation
+        # sees every phase through a single key space.
+        mine = {k: h.to_dict() for k, h in self._hist.items() if len(h)}
+        if mine:
+            body["hist"] = {**body.get("hist", {}), **mine}
         with self._lock:
             body["cluster"] = {
                 "address": self.addr_s,
@@ -1743,8 +1782,100 @@ class ClusterNode:
                     "demotions": self.demotions,
                     "rehomed_parts": self.rehomed_parts,
                 },
+                # Cluster-scope aggregation health (round 12): pulls =
+                # peer METRICS_PULL requests issued, merges = rollups
+                # computed, unreachable_peers = peers a pull could not
+                # reach (each one also logged via obs/logctx).
+                "agg": {
+                    "pulls": self.agg_pulls,
+                    "merges": self.agg_merges,
+                    "unreachable_peers": self.agg_unreachable,
+                },
             }
         return body
+
+    def cluster_metrics_view(self) -> dict:
+        """``GET /metrics?scope=cluster``: fan a METRICS_PULL over the
+        current view (bounded, per-peer ``stats_timeout_s`` deadlines —
+        the handler thread never hangs on a partitioned member) and merge
+        the reachable members' bodies into a rollup (``obs/agg.py``:
+        histograms vector-add, whitelisted counters sum, floors min).
+
+        Degrades honestly: an unreachable peer is flagged
+        ``unreachable`` (and logged with the peer identified), a peer
+        whose (term, epoch) disagrees with ours is flagged ``stale`` —
+        its numbers still merge (they are real samples), but the reader
+        knows the membership pictures differ.  Any member can serve
+        this; the fan-out runs over the caller's own view."""
+        with self._lock:
+            peers = [m for m in self.network if m != self.addr_s]
+            view = (self.net_term, self.net_epoch)
+            coordinator = self.coordinator
+        payload = {
+            "method": "METRICS_PULL",
+            "from": self.addr_s,
+            "term": view[0],
+            "epoch": view[1],
+        }
+        results = wire.fanout_requests(
+            self._transport, peers, payload, self.config.stats_timeout_s
+        )
+        nodes: dict = {
+            self.addr_s: {
+                "unreachable": False,
+                "stale": False,
+                "view": list(view),
+                "metrics": self.metrics_view(),
+            }
+        }
+        unreachable = 0
+        for m, res in zip(peers, results):
+            if res is None or not isinstance(res.get("metrics"), dict):
+                nodes[m] = {
+                    "unreachable": True,
+                    "stale": False,
+                    "view": None,
+                    "metrics": None,
+                }
+                unreachable += 1
+                # The aggregation-degraded event: peer identified, so an
+                # operator greps the address straight to the evidence.
+                ctx_log(_LOG, "peer", m).warning(
+                    "[%s] cluster metrics pull got no usable reply — "
+                    "rollup degrades to %d/%d members",
+                    self.addr_s, len(peers) + 1 - unreachable, len(peers) + 1,
+                )
+            else:
+                peer_view = (int(res.get("term", -1)), int(res.get("epoch", -1)))
+                nodes[m] = {
+                    "unreachable": False,
+                    "stale": peer_view != view,
+                    "view": list(peer_view),
+                    "metrics": res["metrics"],
+                }
+        with self._lock:
+            self.agg_pulls += len(peers)
+            self.agg_merges += 1
+            self.agg_unreachable += unreachable
+        rollup = agg.rollup(
+            [n["metrics"] for n in nodes.values() if n["metrics"] is not None]
+        )
+        rollup["nodes"] = len(nodes)
+        rollup["unreachable"] = unreachable
+        return {
+            "scope": "cluster",
+            "address": self.addr_s,
+            "coordinator": coordinator,
+            "view": list(view),
+            "nodes": nodes,
+            "rollup": rollup,
+        }
+
+    def status_view(self) -> dict:
+        """``GET /status``: the compact SLO/health plane derived from one
+        cluster-scope pull (member reachability/staleness, cluster
+        quantiles, the RPC-floor estimate, SLO state)."""
+        return agg.status_from(self.cluster_metrics_view())
 
     def network_view(self) -> dict:
         """Reference `/network` shape (``DHT_Node.py:600-614``)."""

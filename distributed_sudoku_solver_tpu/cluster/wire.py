@@ -123,6 +123,39 @@ def send_msg(addr: Addr, msg: dict, timeout: float = 5.0) -> None:
         ) from e
 
 
+def fanout_requests(transport, peers, payload: dict, timeout: float) -> list:
+    """Parallel request/reply fan-out with per-peer timeouts — the shape
+    ``stats_view`` always used, now shared with the cluster metrics pull
+    (METRICS_PULL, ``GET /metrics?scope=cluster``).
+
+    One daemon thread per peer, each bounded by ``timeout``; a peer that
+    fails, is partitioned, or answers late yields ``None`` in its slot.
+    The caller's wall time is bounded by ~``timeout`` + join slack, never
+    O(peers) serial timeouts — which is what keeps the aggregation
+    endpoints from ever hanging an HTTP handler thread on a degraded
+    ring.  ``peers`` are addr strings or parsed ``Addr`` tuples."""
+    results: list = [None] * len(peers)
+
+    def ask(i: int, peer) -> None:
+        addr = peer if isinstance(peer, tuple) else parse_addr(peer)
+        try:
+            results[i] = transport.request(addr, payload, timeout)
+        except WireError:
+            pass  # slot stays None: the caller flags the peer
+
+    threads = [
+        threading.Thread(target=ask, args=(i, m), daemon=True)
+        for i, m in enumerate(peers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout + 1.0)
+    # Snapshot: a straggler thread finishing after its join timeout must
+    # not mutate what the caller is already iterating.
+    return list(results)
+
+
 def request(addr: Addr, msg: dict, timeout: float = 5.0) -> dict:
     """Send one message and wait for one reply frame on the same connection."""
     try:

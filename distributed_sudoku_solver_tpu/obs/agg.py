@@ -1,0 +1,127 @@
+"""Cluster-scope metrics aggregation: per-node bodies -> one honest rollup.
+
+The coordinator-side half of ``GET /metrics?scope=cluster``: given the
+``/metrics`` bodies of every reachable member (its own plus METRICS_PULL
+replies, ``cluster/node.py``), :func:`rollup` merges exactly the things
+that merge *soundly*:
+
+* **Histograms** (``hist`` sections, ``obs/hist.py`` log2 dicts) merge by
+  vector add — the whole reason the histogram plane exists.  Cluster
+  quantiles are then estimated from the MERGED counts, which is the only
+  honest way to get a cluster p95 (averaging per-node p95s is not).
+* **A small counter whitelist** (``jobs_done`` / ``solved`` /
+  ``validations``) sums.
+* **RPC-floor estimates** (``rpc_floor_ms``) min-merge: the ring's floor
+  is the best floor any member has measured.
+
+Everything else — percentile snapshots, per-geometry breakdowns, string
+state — is deliberately NOT rolled up: those live in the per-node
+breakdown the endpoint returns alongside, where they are still true.
+
+:func:`status_from` derives the compact ``GET /status`` health view from
+a cluster view (member reachability/staleness flags, cluster quantiles,
+the floor, and the SLO plane's state).
+
+Stdlib + sibling ``obs`` modules only; never imports the serving or
+cluster layers back.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from distributed_sudoku_solver_tpu.obs import hist as hist_mod
+from distributed_sudoku_solver_tpu.obs import slo as slo_mod
+
+# Scalar counters that sum soundly across members (lifetime totals with
+# one writer each).  Windowed or ratio-shaped values never belong here.
+SUM_COUNTERS = ("jobs_done", "solved", "validations")
+
+QUANTILES = (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99))
+
+
+def rollup(bodies: Iterable[Optional[dict]]) -> dict:
+    """Merge member ``/metrics`` bodies (None/garbage entries skipped —
+    the caller flags those peers unreachable) into the cluster rollup."""
+    hists: dict = {}
+    counters: dict = {}
+    floor: Optional[dict] = None
+    for body in bodies:
+        if not isinstance(body, dict):
+            continue
+        h = body.get("hist")
+        if isinstance(h, dict):
+            for k in sorted(h, key=str):
+                if hist_mod.is_hist(h[k]):
+                    hists[str(k)] = hist_mod.merge_hist(hists.get(str(k)), h[k])
+        for k in SUM_COUNTERS:
+            v = body.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                counters[k] = counters.get(k, 0) + v
+        f = body.get("rpc_floor_ms")
+        if hist_mod.is_min_est(f):
+            floor = hist_mod.merge_min_est(floor, f)
+    quantiles = {}
+    for k, h in hists.items():
+        n = hist_mod.hist_count(h)
+        if n == 0:
+            continue
+        quantiles[k] = {
+            "count": n,
+            **{
+                name: round(hist_mod.hist_quantile(h, q), 3)
+                for name, q in QUANTILES
+            },
+        }
+    out = {"hist": hists, "counters": counters, "quantiles": quantiles}
+    if floor is not None:
+        out["rpc_floor_ms"] = floor
+    return out
+
+
+def status_from(cluster_view: dict) -> dict:
+    """The ``GET /status`` body: membership health + cluster quantiles +
+    the SLO plane, derived from a ``cluster_metrics_view()`` result."""
+    nodes = cluster_view.get("nodes", {})
+    members = {
+        addr: {
+            "stale": bool(n.get("stale")),
+            "unreachable": bool(n.get("unreachable")),
+        }
+        for addr, n in nodes.items()
+    }
+    unreachable = sum(1 for m in members.values() if m["unreachable"])
+    ru = cluster_view.get("rollup", {})
+    mon = slo_mod.active()
+    slo_state = mon.state() if mon is not None else None
+    # Cluster health must see the MEMBERS' SLO planes too: each pulled
+    # metrics body carries its node's slo section (when that node runs
+    # --slo), and a member burning its budget is a cluster problem even
+    # when the serving node's own monitor is green.  The local monitor
+    # stays the fallback for bodies without the section.
+    burning_members = sorted(
+        addr
+        for addr, n in nodes.items()
+        if isinstance(n.get("metrics"), dict)
+        and (n["metrics"].get("slo") or {}).get("burning")
+    )
+    burning = bool(slo_state and slo_state.get("burning")) or bool(
+        burning_members
+    )
+    return {
+        "address": cluster_view.get("address"),
+        "coordinator": cluster_view.get("coordinator"),
+        "view": cluster_view.get("view"),
+        "members": members,
+        "unreachable": unreachable,
+        "quantiles": ru.get("quantiles", {}),
+        "rpc_floor_ms": ru.get("rpc_floor_ms"),
+        "counters": ru.get("counters", {}),
+        "slo": slo_state,
+        "slo_burning_members": burning_members,
+        # Degraded = the aggregation itself is partial (a member did not
+        # answer); healthy additionally requires no objective burning
+        # anywhere in the ring.
+        "degraded": unreachable > 0,
+        "healthy": unreachable == 0 and not burning,
+    }

@@ -19,6 +19,14 @@ structured handlers):
       _LOG.error("[engine] batch failed (%s): %r", uuids_label(jobs), e)
       # -> "... (uuids=1f2e3d4c,9a8b7c6d,+3) ..."
 
+* :func:`ctx_log` — the generic form for non-job identities (an SLO
+  objective's window, a peer whose metrics pull failed)::
+
+      ctx_log(_LOG, "slo", "solve_p95_ms<=250").warning("burn rate ...")
+      # -> "[slo solve_p95_ms<=250] burn rate ..."
+      ctx_log(_LOG, "peer", addr).warning("metrics pull failed: ...")
+      # -> "[peer 10.0.0.2:7000] metrics pull failed: ..."
+
 Stdlib only.
 """
 
@@ -46,6 +54,26 @@ class JobLogAdapter(logging.LoggerAdapter):
 
 def job_log(logger: logging.Logger, uuid: str) -> JobLogAdapter:
     return JobLogAdapter(logger, uuid)
+
+
+class CtxLogAdapter(logging.LoggerAdapter):
+    """Prefixes messages with ``[<tag> <value>]`` and sets the record
+    attribute ``<tag>`` for structured handlers — ``job_log`` generalized
+    to any identity worth grepping for."""
+
+    def __init__(self, logger: logging.Logger, tag: str, value):
+        super().__init__(logger, {tag: value})
+        self._tag = tag
+        self._value = value
+
+    def process(self, msg, kwargs):
+        extra = kwargs.setdefault("extra", {})
+        extra.setdefault(self._tag, self._value)
+        return f"[{self._tag} {self._value}] {msg}", kwargs
+
+
+def ctx_log(logger: logging.Logger, tag: str, value) -> CtxLogAdapter:
+    return CtxLogAdapter(logger, tag, value)
 
 
 def uuids_label(jobs_or_uuids: Iterable, limit: int = 4) -> str:

@@ -15,10 +15,19 @@ fault counters); scraping it requires a JSON exporter sidecar.
 * string leaves become info-style gauges: the string is a label on a
   ``1``-valued metric (``dsst_faults_breaker_state{state="open"} 1``);
 * numeric lists label by ``index`` (occupancy histogram buckets, the
-  ``[term, epoch]`` view).
+  ``[term, epoch]`` view);
+* ``obs/hist.py`` log2 histograms (dicts tagged ``type: log2_hist``)
+  render as real Prometheus histograms: cumulative ``_bucket{le=...}``
+  series (edges in ms, ``+Inf`` last) plus ``_sum``/``_count`` — so a
+  Prometheus server can `histogram_quantile()` across a scraped ring
+  exactly the way ``obs/agg.py`` merges them server-side.  Exemplars
+  stay JSON-only (the classic text format has no exemplar syntax).
+* ``rpc_floor_ms`` floor estimates (``type: min_est``) render their
+  numeric fields as plain gauges.
 
 Output is deterministic (keys sorted at every level) so the golden-file
-test pins the format.  Stdlib only.
+test pins the format, and ``obs/promck.py`` lints the result (duplicate
+series, label escaping, monotone ``le`` buckets).  Stdlib only.
 """
 
 from __future__ import annotations
@@ -34,6 +43,10 @@ _LABEL_DICTS = {
     "duplicates_dropped": "method",
     "dispatches": "site",
     "injected": "site_kind",
+    # SLO objectives ("solve_p95_ms<=250") and cluster member addresses
+    # ("10.0.0.1:7000") are identities, not name-path material.
+    "objectives": "objective",
+    "cluster_nodes": "node",
 }
 
 
@@ -61,6 +74,20 @@ def _line(parts, labels, v) -> str:
     return f"{name} {_fmt(v)}"
 
 
+def _hist_lines(parts: list, labels: list, val: dict, lines: List[str]) -> None:
+    """An obs/hist.py log2 histogram as Prometheus histogram series:
+    cumulative ``le`` buckets (ms edges), then ``_sum`` and ``_count``."""
+    edge0 = float(val.get("edge0_ms", 0.001))
+    counts = val.get("counts") or []
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += int(c)
+        le = "+Inf" if i == len(counts) - 1 else _fmt(edge0 * (2.0 ** i))
+        lines.append(_line(parts + ["bucket"], labels + [("le", le)], cum))
+    lines.append(_line(parts + ["sum"], labels, float(val.get("sum_ms", 0.0))))
+    lines.append(_line(parts + ["count"], labels, cum))
+
+
 def _walk(parts: list, val, labels: list, lines: List[str]) -> None:
     if isinstance(val, bool) or isinstance(val, (int, float)):
         lines.append(_line(parts, labels, val))
@@ -70,6 +97,15 @@ def _walk(parts: list, val, labels: list, lines: List[str]) -> None:
     elif isinstance(val, dict):
         if not val:
             return
+        if val.get("type") == "log2_hist":
+            _hist_lines(parts, labels, val, lines)
+            return
+        if val.get("type") == "min_est":
+            # Floor estimate: numeric fields as gauges, the tag skipped.
+            for k in sorted(val, key=str):
+                if k != "type":
+                    _walk(parts + [str(k)], val[k], labels, lines)
+            return
         keys = sorted(val, key=str)
         if all(isinstance(k, str) and _GEOM_KEY.match(k) for k in keys):
             for k in keys:
@@ -77,7 +113,18 @@ def _walk(parts: list, val, labels: list, lines: List[str]) -> None:
         elif parts and parts[-1] in _LABEL_DICTS:
             label = _LABEL_DICTS[parts[-1]]
             for k in keys:
-                _walk(parts, val[k], labels + [(label, str(k))], lines)
+                child = val[k]
+                child_labels = labels + [(label, str(k))]
+                if isinstance(child, dict):
+                    # Exactly ONE labeled level: the child's own keys are
+                    # ordinary name-path segments (an SLO objective's
+                    # fields, a member's reachability gauges) — without
+                    # this, a nested dict re-matches the rule and emits a
+                    # duplicate label name, which breaks the scrape.
+                    for ck in sorted(child, key=str):
+                        _walk(parts + [str(ck)], child[ck], child_labels, lines)
+                else:
+                    _walk(parts, child, child_labels, lines)
         else:
             for k in keys:
                 _walk(parts + [str(k)], val[k], labels, lines)

@@ -54,7 +54,8 @@ import jax
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
-from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs import slo, trace
+from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram, MinEstimator
 from distributed_sudoku_solver_tpu.obs.logctx import job_log, uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
 from distributed_sudoku_solver_tpu.ops.solve import solve_batch
@@ -257,6 +258,31 @@ class SolverEngine:
         # flight drains.  Rare by construction (resolution chunks only),
         # but recorded so the dispatch/sync split never hides them.
         self.event_wall = StatWindow()
+        # Mergeable log2-bucket histograms (obs/hist.py) recorded beside
+        # the StatWindows at the same phase seams — the StatWindows answer
+        # "this node's p95", the histograms vector-add across nodes into
+        # cluster-scope distributions (GET /metrics?scope=cluster, via
+        # obs/agg.py).  Keys: latency_ms (submit->resolve), solve_ms
+        # (HTTP accept->response, fed by serving/http.py), dispatch/sync/
+        # event walls (static flight loop), admission_wait_ms +
+        # chunk_wall_ms (the resident scheduler's seams — shared across
+        # geometries, serving/scheduler.py records into these).
+        self.hist = {
+            k: LatencyHistogram()
+            for k in (
+                "latency_ms",
+                "solve_ms",
+                "dispatch_wall_ms",
+                "sync_wall_ms",
+                "event_wall_ms",
+                "admission_wait_ms",
+                "chunk_wall_ms",
+            )
+        }
+        # Live RPC-floor estimate from the chunk.sync samples (both serving
+        # loops): the per-sync minimum IS the dispatch floor a tunneled
+        # device pays — the baseline number ROADMAP #2 attacks.
+        self.rpc_floor = MinEstimator()
         # Running totals for the device-step rate (single-writer: the device
         # loop).  On an attached host sync wall bounds device step time;
         # through a tunneled device it includes the per-sync RPC overhead —
@@ -660,6 +686,19 @@ class SolverEngine:
             # Flight-recorder health: ring fill, links, dumps written,
             # spans stitched in from remote nodes (obs/trace.py).
             out["trace"] = rec.metrics()
+        # The mergeable plane (obs/hist.py): phase-decomposed log2
+        # histograms (cluster-scope aggregation vector-adds these across
+        # members) and the live RPC-floor estimate from chunk.sync walls.
+        hist_sec = {k: h.to_dict() for k, h in self.hist.items() if len(h)}
+        if hist_sec:
+            out["hist"] = hist_sec
+        floor = self.rpc_floor.to_dict()
+        if floor is not None:
+            out["rpc_floor_ms"] = floor
+        mon = slo.active()
+        if mon is not None:
+            # SLO plane health (obs/slo.py): burn rates, breaches, dumps.
+            out["slo"] = mon.metrics()
         if self._occ_chunks > 0:
             # Lane-occupancy inside fused dispatches: counts[k] = lanes
             # observed live for [10k, 10(k+1))% of the rounds their chunk
@@ -1207,7 +1246,9 @@ class SolverEngine:
         fl.chunks += 1
         prev_status = fl.pending_status
         fl.pending_status = status_dev
-        self.dispatch_wall.record(time.monotonic() - t_pass)
+        dispatch_s = time.monotonic() - t_pass
+        self.dispatch_wall.record(dispatch_s)
+        self.hist["dispatch_wall_ms"].record(dispatch_s)
         if rec is not None:
             live_uuids = [j.uuid for j in fl.jobs if not j.done.is_set()]
             rec.record(
@@ -1228,7 +1269,10 @@ class SolverEngine:
             host_fetch(prev_status, floor_s=self.handicap_s),
             fl.state.solved.shape[0],
         )
-        self.sync_wall.record(time.monotonic() - t_sync)
+        sync_s = time.monotonic() - t_sync
+        self.sync_wall.record(sync_s)
+        self.hist["sync_wall_ms"].record(sync_s)
+        self.rpc_floor.record(sync_s)
         if rec is not None:
             rec.record(
                 None, "chunk.sync", "fetch.status", tr1,
@@ -1275,7 +1319,9 @@ class SolverEngine:
             floor_s=self.handicap_s,
             tag="finalize",
         )
-        self.event_wall.record(time.monotonic() - t_ev)
+        fin_s = time.monotonic() - t_ev
+        self.event_wall.record(fin_s)
+        self.hist["event_wall_ms"].record(fin_s)
         if rec is not None:
             rec.record(
                 None, "finalize.sync", "fetch.finalize", tr_ev,
@@ -1325,6 +1371,7 @@ class SolverEngine:
         )
         ev = time.monotonic() - t_ev
         self.event_wall.record(ev)
+        self.hist["event_wall_ms"].record(ev)
         if rec is not None:
             rec.record(
                 None, "verdict.sync", "fetch.event", tr_ev,
@@ -1344,12 +1391,24 @@ class SolverEngine:
             self._finish_job(job)
 
     def _finish_job(self, job: Job) -> None:
-        self.latency.record(time.monotonic() - job.submitted_at)
+        wall = time.monotonic() - job.submitted_at
+        self.latency.record(wall)
         if job.solved:
             self.solved_count += 1
         self.validations += job.nodes
         self.jobs_done += 1
         rec = trace.active()
+        # Histogram exemplar (the uuid linking a slow bucket to its
+        # stitched trace) only when a recorder is installed — the
+        # untraced path passes the None default, allocating nothing.
+        self.hist["latency_ms"].record(
+            wall, exemplar=job.uuid if rec is not None else None
+        )
+        # SLO observation seam (obs/slo.py): one global read + branch
+        # when no --slo monitor is installed, like the tracer.
+        mon = slo.active()
+        if mon is not None:
+            mon.observe(wall, error=job.error is not None, stream="job")
         if rec is not None:
             rec.event(
                 job.uuid, "resolve", "engine.resolve", node=self.trace_node,
@@ -1500,6 +1559,7 @@ class SolverEngine:
 
         now = time.monotonic()
         rec = trace.active()
+        mon = slo.active()
         for i, job in enumerate(group):
             job.solved = bool(solved[i])
             job.unsat = bool(unsat[i])
@@ -1509,7 +1569,13 @@ class SolverEngine:
                 job.solution = solutions[i]
             if self._consume_cancel(job):
                 job.cancelled = True
-            self.latency.record(now - job.submitted_at)
+            wall = now - job.submitted_at
+            self.latency.record(wall)
+            self.hist["latency_ms"].record(
+                wall, exemplar=job.uuid if rec is not None else None
+            )
+            if mon is not None:
+                mon.observe(wall, error=job.error is not None, stream="job")
             if rec is not None:
                 rec.event(
                     job.uuid, "resolve", "engine.resolve",

@@ -10,15 +10,22 @@ DHT_Node.py:540-614`` (SudokuHandler):
 
 Superset endpoints (absent from the reference):
 
-* ``GET /metrics`` — latency percentiles, batch sizes, device info.
-  Since round 8 the flight-loop wall is split into ``dispatch_wall_ms``
-  (host time enqueueing device work — async, near zero),
-  ``sync_wall_ms`` (host time blocked in the one per-chunk status
-  fetch), and ``event_wall_ms`` (the rarer verdict/finalize fetches on
-  chunks where a job resolved), so the always-ahead loop's host/device
-  overlap is observable; the resident section's ``chunk_wall_ms`` is
-  likewise the per-round status sync wall, with its own
-  ``dispatch_wall_ms`` / ``event_wall_ms``.
+* ``GET /metrics`` — latency percentiles, batch sizes, fault/breaker
+  counters, mergeable phase histograms (``hist`` section, obs/hist.py),
+  the live ``rpc_floor_ms`` estimate, device info.  Since round 8 the
+  flight-loop wall is split into ``dispatch_wall_ms`` (host time
+  enqueueing device work — async, near zero), ``sync_wall_ms`` (host
+  time blocked in the one per-chunk status fetch), and
+  ``event_wall_ms`` (the rarer verdict/finalize fetches on chunks where
+  a job resolved), so the always-ahead loop's host/device overlap is
+  observable; the resident section's ``chunk_wall_ms`` is likewise the
+  per-round status sync wall, with its own ``dispatch_wall_ms`` /
+  ``event_wall_ms``.  Query params: ``?format=prometheus`` renders text
+  exposition (obs/prom.py, linted by obs/promck.py);
+  ``?scope=cluster`` fans a METRICS_PULL over the ring and returns the
+  per-node breakdown plus a merged rollup (obs/agg.py) whose histogram
+  counts are the vector sum of the members' — partitioned members are
+  flagged ``unreachable``, never hung on.
 * ``POST /solve`` with ``"count_all": true`` — enumerate EVERY solution
   to exhaustion and return the exact model count plus the first solution
   found (the reference's DFS stops at one solution and cannot express
@@ -60,7 +67,11 @@ Differences are deliberate upgrades, not behavior drift:
 * ``/stats`` aggregation uses the cluster runtime's snapshot instead of a
   blind 1 s sleep window (``:571``).
 
-Observability endpoints (round 11, ``obs/``):
+Observability endpoints (rounds 11-12, ``obs/``) — the full endpoint set
+served here is: ``POST /solve``, ``POST /solve_batch``, ``POST
+/profile``, ``GET /stats``, ``GET /network``, ``GET /metrics``
+(``?format=prometheus``, ``?scope=cluster``), ``GET /trace[/uuid]``
+(``?format=perfetto``), ``GET /status``, ``GET /slo``:
 
 * ``GET /trace`` — recent flight-recorder spans (JSON);
   ``?format=perfetto`` exports the ring as Chrome-trace JSON (open in
@@ -69,7 +80,15 @@ Observability endpoints (round 11, ``obs/``):
 * ``GET /trace/<uuid>`` — one job's stitched trace (spans from every
   cluster node that touched it).
 * ``GET /metrics?format=prometheus`` — the nested metrics dict flattened
-  into Prometheus text exposition (``obs/prom.py``).
+  into Prometheus text exposition (``obs/prom.py``); with
+  ``scope=cluster`` the federated form: the merged rollup plus per-node
+  reachability gauges.
+* ``GET /metrics?scope=cluster`` — the cluster-scope merge (see above).
+* ``GET /status`` — compact health: member reachability/staleness,
+  cluster latency quantiles from the merged histograms, the
+  ``rpc_floor_ms`` estimate, and the SLO plane's state (``obs/agg.py``).
+* ``GET /slo`` — the SLO monitor's objectives, burn rates, and breach
+  counters (``obs/slo.py``); 404 unless the node runs with ``--slo``.
 * ``POST /profile`` ``{"secs": 1.0, "logdir": "..."} `` — a bounded
   ``jax.profiler`` device-trace window (``utils/profiling.py``); one
   window at a time (409 while open).
@@ -84,7 +103,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
-from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs import agg, slo, trace
 from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
 from distributed_sudoku_solver_tpu.serving.scheduler import EngineSaturated
 
@@ -148,10 +167,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(400, {"error": str(e)})
             if res.winner is None:
                 if res.timed_out:
+                    self._record_solve(node, time.time() - start, 504)
                     return self._send(504, {"error": "portfolio race timed out"})
                 # Every racer resolved without a verdict: a permanent
                 # budget/overflow failure, not a retryable timeout.
                 err = next((j.error for j in res.jobs if j.error), None)
+                self._record_solve(node, time.time() - start, 500)
                 return self._send(500, {"error": err or "search budget exhausted"})
             job = res.winner
             strategy = res.strategy
@@ -178,26 +199,27 @@ class _Handler(BaseHTTPRequestHandler):
             if not job.wait(timeout):
                 node.cancel(job.uuid)
                 self._trace_http(rec, t_http, job.uuid, 504)
+                self._record_solve(node, time.time() - start, 504)
                 return self._send(504, {"error": "solve timed out", "uuid": job.uuid})
         duration = time.time() - start
         extra = {"strategy": strategy} if strategy is not None else {}
         if job.solved:
-            self._trace_http(rec, t_http, job.uuid, 201)
-            return self._send(
-                201,
-                {"solution": job.solution.tolist(), "duration": duration, **extra},
-            )
-        if job.unsat:
-            self._trace_http(rec, t_http, job.uuid, 422)
-            return self._send(
-                422,
-                {"error": "puzzle is unsatisfiable", "duration": duration, **extra},
-            )
-        self._trace_http(rec, t_http, job.uuid, 500)
-        return self._send(
-            500,
-            {"error": job.error or "search budget exhausted", "duration": duration},
-        )
+            status = 201
+            body = {"solution": job.solution.tolist(), "duration": duration,
+                    **extra}
+        elif job.unsat:
+            status = 422
+            body = {"error": "puzzle is unsatisfiable", "duration": duration,
+                    **extra}
+        else:
+            status = 500
+            body = {
+                "error": job.error or "search budget exhausted",
+                "duration": duration,
+            }
+        self._trace_http(rec, t_http, job.uuid, status)
+        self._record_solve(node, duration, status)
+        return self._send(status, body)
 
     @staticmethod
     def _trace_http(rec, t0: float, job_uuid: str, status: int) -> None:
@@ -205,6 +227,24 @@ class _Handler(BaseHTTPRequestHandler):
         (obs/trace.py; a no-op unless a recorder is installed)."""
         if rec is not None:
             rec.record(job_uuid, "http.solve", "http", t0, status=status)
+
+    @staticmethod
+    def _record_solve(node, duration: float, status: int) -> None:
+        """The http-solve wall (obs/hist.py ``solve_ms`` + the SLO
+        ``solve`` stream): one sample per completed ``/solve`` whatever
+        the status and whichever branch produced it (plain, portfolio,
+        count_all) — the cluster-scope p95 over this phase is the
+        serving-tier SLI the ``--slo`` grammar names
+        (``solve_p95_ms<=...``).  5xx statuses — including a 504
+        timeout, where the job merely got cancelled and carries no
+        ``job.error`` — count as errors for ``error_rate``: the SLO
+        plane watches what the CLIENT saw, not what the engine felt."""
+        eng = getattr(node, "engine", None)
+        if eng is not None:
+            eng.hist["solve_ms"].record(duration)
+        mon = slo.active()
+        if mon is not None:
+            mon.observe(duration, error=status >= 500, stream="solve")
 
     def _solve_count_all(self, node, grid, start, timeout):
         """``POST /solve`` with ``"count_all": true``: enumerate EVERY
@@ -236,8 +276,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": str(e)})
         if not job.wait(timeout):
             engine.cancel(job.uuid)
+            self._record_solve(node, time.time() - start, 504)
             return self._send(504, {"error": "enumeration timed out"})
         if job.error:
+            self._record_solve(node, time.time() - start, 500)
             return self._send(500, {"error": job.error})
         body = {
             "count": int(job.sol_count),
@@ -248,6 +290,7 @@ class _Handler(BaseHTTPRequestHandler):
             "duration": time.time() - start,
             "scope": "local",  # enumeration never distributes (see docstring)
         }
+        self._record_solve(node, body["duration"], 200)
         return self._send(200, body)
 
     @staticmethod
@@ -431,15 +474,80 @@ class _Handler(BaseHTTPRequestHandler):
             # Superset endpoint (not in the reference): per-node latency
             # percentiles, batch sizes, device info — SURVEY.md §5.5.
             # ?format=prometheus flattens the nested dict into text
-            # exposition lines (obs/prom.py) for direct scraping.
+            # exposition lines (obs/prom.py) for direct scraping;
+            # ?scope=cluster fans a METRICS_PULL over the ring and merges
+            # (obs/agg.py) — partitioned members are flagged, never hung on.
+            if query.get("scope", [""])[0] == "cluster":
+                return self._cluster_metrics(node, query)
             if query.get("format", [""])[0] == "prometheus":
                 from distributed_sudoku_solver_tpu.obs import prom
 
                 return self._send_text(200, prom.render(self._metrics(node)))
             return self._send(200, self._metrics(node))
+        if path == "/status":
+            # Compact SLO/health plane: member reachability, cluster
+            # quantiles from the merged histograms, floor, SLO state.
+            return self._send(200, agg.status_from(self._cluster_view(node)))
+        if path == "/slo":
+            mon = slo.active()
+            if mon is None:
+                return self._send(
+                    404,
+                    {"error": "no SLO configured (start the node with --slo)"},
+                )
+            return self._send(200, mon.state())
         if path == "/trace" or path.startswith("/trace/"):
             return self._trace_view(path, query)
         return self._send(404, {"error": "not found"})
+
+    @staticmethod
+    def _cluster_view(node) -> dict:
+        """The node's cluster-scope metrics view (single-node shape for a
+        bare engine that predates the cluster surface)."""
+        fn = getattr(node, "cluster_metrics_view", None)
+        if fn is not None:
+            return fn()
+        engine = getattr(node, "engine", None)
+        m = engine.metrics() if engine is not None else {}
+        addr = getattr(node, "address", "local:0")
+        return {
+            "scope": "cluster",
+            "address": addr,
+            "coordinator": addr,
+            "view": [0, 0],
+            "nodes": {
+                addr: {
+                    "unreachable": False,
+                    "stale": False,
+                    "view": [0, 0],
+                    "metrics": m,
+                }
+            },
+            "rollup": {**agg.rollup([m]), "nodes": 1, "unreachable": 0},
+        }
+
+    def _cluster_metrics(self, node, query: dict):
+        """``GET /metrics?scope=cluster``: the per-node breakdown + merged
+        rollup; ``&format=prometheus`` renders the federated form (the
+        rollup's series plus per-node reachability gauges — per-node full
+        bodies stay JSON-only, each member already serves its own
+        exposition)."""
+        cm = self._cluster_view(node)
+        if query.get("format", [""])[0] == "prometheus":
+            from distributed_sudoku_solver_tpu.obs import prom
+
+            doc = {
+                "cluster_rollup": cm.get("rollup", {}),
+                "cluster_nodes": {
+                    addr: {
+                        "unreachable": n.get("unreachable", False),
+                        "stale": n.get("stale", False),
+                    }
+                    for addr, n in cm.get("nodes", {}).items()
+                },
+            }
+            return self._send_text(200, prom.render(doc))
+        return self._send(200, cm)
 
     def _trace_view(self, path: str, query: dict):
         """``GET /trace`` (recent ring; ``?format=perfetto`` for Chrome-

@@ -445,7 +445,14 @@ class ResidentFlight:
         )
         self._pending_status = None
         self._status = unpack_status(raw, self.n_slots)
-        self.chunk_wall.record(time.monotonic() - t0)
+        sync_s = time.monotonic() - t0
+        self.chunk_wall.record(sync_s)
+        # The mergeable twin + the floor estimator (obs/hist.py): resident
+        # chunk syncs share the engine-level histograms so cluster-scope
+        # aggregation sees one distribution per phase, not one per
+        # geometry object.
+        self.engine.hist["chunk_wall_ms"].record(sync_s)
+        self.engine.rpc_floor.record(sync_s)
         self.chunks += 1
         # A consumed chunk is the breaker's definition of success: it
         # resets the consecutive-failure count and closes a half-open
@@ -559,6 +566,7 @@ class ResidentFlight:
             )
             self._event_wall = time.monotonic() - t_ev
             self.event_wall.record(self._event_wall)
+            self.engine.hist["event_wall_ms"].record(self._event_wall)
             if rec is not None:
                 rec.record(
                     None, "verdict.sync", "fetch.event", tr_ev,
@@ -655,7 +663,9 @@ class ResidentFlight:
         for i, (slot, job) in enumerate(batch):
             grids[i] = job.grid
             slot_ids[i] = slot
-            self.admission_wait.record(now - job.submitted_at)
+            wait_s = now - job.submitted_at
+            self.admission_wait.record(wait_s)
+            self.engine.hist["admission_wait_ms"].record(wait_s)
         self.state = _attach_jit(
             self.state, jnp.asarray(grids), jnp.asarray(slot_ids),
             self.geom, self.gang,

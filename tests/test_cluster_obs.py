@@ -1,0 +1,749 @@
+"""Round-12 cluster-scope observability: mergeable log2 histograms
+(obs/hist.py), the SLO/burn-rate plane (obs/slo.py), cluster-scope
+aggregation (obs/agg.py + METRICS_PULL), the promck exposition lint, and
+the bench regression gate (benchmarks/regress.py).
+
+Four layers of assertions:
+
+* **Histogram unit lane** — bucket edges, vector-add merge (scheme
+  mismatch refused), quantile estimation, exemplars, the floor estimator.
+* **SLO unit lane** — grammar parsing, burn-rate windowing on a fake
+  clock, the exactly-one-dump-per-crossing edge semantics (simnet-marked:
+  the conftest guard proves no sleeps back the determinism claim).
+* **API lane** — ``GET /status`` / ``GET /slo`` /
+  ``GET /metrics?scope=cluster`` live on a standalone node, the federated
+  Prometheus form passing promck, and the microcheck that with no
+  ``--slo`` and tracing off the hot path records nothing extra.
+* **Simnet acceptance** — a 3-node ring's cluster-scope merge: rollup
+  counts equal the vector sum of per-node counts, bit-identical across
+  two independent runs on the virtual clock; a partitioned member is
+  flagged ``unreachable`` without blocking the pull.
+"""
+
+import importlib.util
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.obs import agg, hist, promck, slo, trace
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+SMALL = SolverConfig(min_lanes=8, stack_slots=16)
+
+
+@pytest.fixture(autouse=True)
+def _clean_seams():
+    """Every test leaves the process-wide obs seams empty."""
+    yield
+    trace.install(None)
+    slo.install(None)
+
+
+# -- histogram unit lane -------------------------------------------------------
+
+
+def test_hist_bucket_edges_and_counts():
+    h = hist.LatencyHistogram()
+    # 1 µs edge scheme: 0.5 µs -> bucket 0; exactly 1 µs -> bucket 0;
+    # 1.5 µs -> bucket 1; 250 ms -> the 262.144 ms bucket (1 µs * 2^18).
+    h.record(0.5e-6)
+    h.record(1e-6)
+    h.record(1.5e-6)
+    h.record(0.250)
+    d = h.to_dict()
+    assert len(d["counts"]) == hist.N_BUCKETS
+    assert d["counts"][0] == 2
+    assert d["counts"][1] == 1
+    i250 = hist.bucket_index(250.0)
+    assert i250 == 18
+    assert hist.bucket_edge_ms(i250) == pytest.approx(262.144)
+    assert d["counts"][i250] == 1
+    assert len(h) == 4
+    # Overflow: beyond the last finite edge lands in the +Inf bucket.
+    h.record(1e9)
+    assert h.to_dict()["counts"][-1] == 1
+    # Edge values sit in their own bucket (le semantics): v == edge.
+    assert hist.bucket_index(hist.EDGE0_MS * 8) == 3
+    assert hist.bucket_index(hist.EDGE0_MS * 8.0001) == 4
+
+
+def test_hist_merge_is_vector_add_and_refuses_mismatch():
+    a, b = hist.LatencyHistogram(), hist.LatencyHistogram()
+    for v in (0.001, 0.002, 0.5):
+        a.record(v)
+    for v in (0.002, 0.004):
+        b.record(v)
+    da, db = a.to_dict(), b.to_dict()
+    merged = hist.merge_hist(hist.merge_hist(None, da), db)
+    assert merged["counts"] == [
+        x + y for x, y in zip(da["counts"], db["counts"])
+    ]
+    assert merged["sum_ms"] == pytest.approx(da["sum_ms"] + db["sum_ms"])
+    assert hist.hist_count(merged) == 5
+    # Merging must never change the inputs' identity semantics: a fresh
+    # accumulator from `None` is a copy, not an alias.
+    assert merged["counts"] != da["counts"]
+    with pytest.raises(ValueError):
+        hist.merge_hist({"type": "log2_hist", "edge0_ms": 1.0,
+                         "counts": [0] * 8}, db)
+    with pytest.raises(ValueError):
+        hist.merge_hist(None, {"not": "a hist"})
+
+
+def test_hist_quantiles_bracket_the_samples():
+    h = hist.LatencyHistogram()
+    for _ in range(95):
+        h.record(0.010)  # 10 ms
+    for _ in range(5):
+        h.record(1.0)  # 1 s tail
+    p50 = h.quantile(0.5)
+    p99 = h.quantile(0.99)
+    # Log-bucket estimates: p50 within the 10 ms bucket (8, 16], p99 in
+    # the 1 s bucket (512, 1024].
+    assert 8.0 <= p50 <= 16.0
+    assert 512.0 <= p99 <= 1024.0
+    assert hist.hist_quantile({"type": "log2_hist", "counts": [0] * 32}, 0.5) is None
+
+
+def test_hist_exemplar_links_bucket_to_trace():
+    h = hist.LatencyHistogram()
+    h.record(0.5, exemplar="slow-job-uuid")
+    h.record(0.5)  # no exemplar: must not clobber with None
+    d = h.to_dict()
+    i = hist.bucket_index(500.0)
+    assert d["exemplars"] == {str(i): "slow-job-uuid"}
+    # Merge keeps the donor's exemplar available on the rollup.
+    merged = hist.merge_hist(None, d)
+    assert merged["exemplars"][str(i)] == "slow-job-uuid"
+
+
+def test_min_estimator_floor_and_recent_window():
+    m = hist.MinEstimator(window=4)
+    assert m.to_dict() is None
+    for v in (0.080, 0.075, 0.090, 0.085):  # first window: min 75 ms
+        m.record(v)
+    d = m.to_dict()
+    assert d["min"] == pytest.approx(75.0)
+    assert d["recent"] == pytest.approx(75.0)
+    for v in (0.050, 0.060, 0.055, 0.058):  # floor dropped: recent follows
+        m.record(v)
+    d = m.to_dict()
+    assert d["min"] == pytest.approx(50.0)
+    assert d["recent"] == pytest.approx(50.0)
+    assert d["samples"] == 8
+    # Cluster merge: min of mins, samples sum.
+    other = {"type": "min_est", "min": 42.0, "recent": 44.0, "samples": 3}
+    merged = hist.merge_min_est(hist.merge_min_est(None, d), other)
+    assert merged["min"] == pytest.approx(42.0)
+    assert merged["samples"] == 11
+
+
+# -- slo unit lane -------------------------------------------------------------
+
+
+def test_parse_slo_grammar():
+    objs = slo.parse_slo("solve_p95_ms<=250, error_rate<=0.01")
+    assert [o.kind for o in objs] == ["latency", "error_rate"]
+    assert objs[0].threshold == 250.0
+    assert objs[0].budget == pytest.approx(0.05)
+    assert objs[0].stream == "solve" and objs[1].stream == "solve"
+    assert objs[1].budget == pytest.approx(0.01)
+    assert slo.parse_slo("solve_p50_ms<100")[0].budget == pytest.approx(0.5)
+    assert slo.parse_slo("job_p95_ms<=250")[0].stream == "job"
+    # Unknown streams fail the boot loudly — a typo'd objective must not
+    # quietly monitor nothing.
+    for bad in ("", "p95<=250", "solve_p95_ms>=250", "error_rate<=1.5",
+                "solve_p100_ms<=250", "sovle_p95_ms<=250",
+                "admission_p95_ms<=50", "nonsense"):
+        with pytest.raises(ValueError):
+            slo.parse_slo(bad)
+
+
+@pytest.mark.simnet
+def test_slo_burn_fires_dump_exactly_once_per_crossing(tmp_path, caplog):
+    """The edge semantics: crossing the burn threshold dumps ONCE; staying
+    over it dumps no more; recovering re-arms; a second crossing dumps
+    again.  All on a fake clock — the simnet purity guard proves no
+    sleeps back this determinism."""
+    t = [0.0]
+    rec = trace.TraceRecorder(clock=lambda: t[0], dump_dir=str(tmp_path))
+    mon = slo.SloMonitor(
+        slo.parse_slo("solve_p95_ms<=100"),
+        window_s=60.0,
+        burn_threshold=1.0,
+        min_samples=5,
+        clock=lambda: t[0],
+        metrics_fn=lambda: {"jobs_done": 1},
+    )
+    with trace.installed(rec):
+        for _ in range(20):  # a healthy window
+            mon.observe(0.010)
+        assert not mon.burning()
+        with caplog.at_level(logging.WARNING):
+            for _ in range(5):  # >5% of the window slow: burn >= 1.0
+                mon.observe(0.500)
+        assert mon.burning()
+        assert mon.burns == 1 and mon.dumps == 1
+        # Level, not edge: staying in breach must not dump again.
+        for _ in range(5):
+            mon.observe(0.500)
+        assert mon.dumps == 1
+        # The breach log names the objective's window (obs/logctx).
+        assert any(
+            "[slo solve_p95_ms<=100]" in r.getMessage()
+            for r in caplog.records
+        )
+        # Recovery: the window ages out on the clock, state re-arms.
+        t[0] += 120.0
+        for _ in range(20):
+            mon.observe(0.010)
+        assert not mon.burning()
+        # Second crossing: a second dump.
+        for _ in range(6):
+            mon.observe(0.500)
+        assert mon.burns == 2 and mon.dumps == 2
+    dumps = [f for f in os.listdir(tmp_path) if "slo_burn" in f]
+    assert len(dumps) == 2, dumps
+    doc = json.loads((tmp_path / sorted(dumps)[0]).read_text())
+    assert doc["reason"] == "slo_burn"
+    assert doc["metrics"]["objective"] == "solve_p95_ms<=100"
+    assert doc["metrics"]["metrics"] == {"jobs_done": 1}
+
+
+def test_slo_state_decays_without_traffic():
+    t = [0.0]
+    mon = slo.SloMonitor(
+        slo.parse_slo("error_rate<=0.01"), window_s=10.0,
+        burn_threshold=1.0, min_samples=2, clock=lambda: t[0],
+    )
+    for _ in range(5):
+        mon.observe(0.001, error=True)
+    assert mon.burning()
+    t[0] += 30.0  # window ages out with NO further observations
+    assert not mon.burning()
+    st = mon.state()
+    assert st["objectives"]["error_rate<=0.01"]["window_total"] == 0
+    assert st["burns"] == 1  # history survives the decay
+
+
+def test_slo_streams_are_independent():
+    """A 504 storm burns the solve stream even though the underlying jobs
+    merely got cancelled (no job.error), and job-stream observations
+    never pollute a solve objective's window — the review finding that a
+    100%-timeout outage must not read as healthy."""
+    t = [0.0]
+    mon = slo.SloMonitor(
+        slo.parse_slo("error_rate<=0.1,job_p95_ms<=1000"),
+        window_s=60.0, burn_threshold=1.0, min_samples=3,
+        clock=lambda: t[0],
+    )
+    # The 504 path: http records solve-stream errors; the engine records
+    # fast, error-free job resolutions (cancel resolves quickly).
+    for _ in range(5):
+        mon.observe(30.0, error=True, stream="solve")   # client saw 504
+        mon.observe(0.010, error=False, stream="job")   # engine felt fine
+    st = mon.state()
+    assert st["objectives"]["error_rate<=0.1"]["burning"] is True
+    assert st["objectives"]["error_rate<=0.1"]["window_total"] == 5
+    assert st["objectives"]["job_p95_ms<=1000"]["burning"] is False
+    assert st["objectives"]["job_p95_ms<=1000"]["window_total"] == 5
+
+
+# -- engine/API lane -----------------------------------------------------------
+
+
+def test_microcheck_no_slo_no_trace_records_no_obs_extras(monkeypatch):
+    """Acceptance: with no --slo and tracing off, the per-chunk hot path
+    adds no allocation beyond the always-on histogram increments — the
+    SLO observe seam is never entered and no exemplar string ever reaches
+    a histogram (mirrors PR 8's disabled-tracing microcheck)."""
+    assert trace.active() is None and slo.active() is None
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("SLO observed while no monitor is installed")
+
+    monkeypatch.setattr(slo.SloMonitor, "observe", boom)
+    orig = hist.LatencyHistogram.record
+
+    def checked(self, seconds, exemplar=None):
+        assert exemplar is None, "exemplar built while tracing is disabled"
+        return orig(self, seconds, exemplar)
+
+    monkeypatch.setattr(hist.LatencyHistogram, "record", checked)
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=2).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_engine_metrics_carry_hist_and_floor():
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=2).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+        m = eng.metrics()
+    finally:
+        eng.stop(timeout=2)
+    assert hist.is_hist(m["hist"]["latency_ms"])
+    assert hist.hist_count(m["hist"]["latency_ms"]) >= 1
+    # The flight loop ran chunks: sync walls recorded, floor estimated.
+    assert hist.hist_count(m["hist"]["sync_wall_ms"]) >= 1
+    assert hist.is_min_est(m["rpc_floor_ms"])
+    assert m["rpc_floor_ms"]["min"] >= 0.0
+
+
+def test_slo_flip_and_status_endpoints_live(tmp_path):
+    """Acceptance: an induced latency burst crossing the configured SLO
+    burn threshold flips GET /slo state and writes exactly one
+    flight-recorder dump; GET /status and GET /metrics?scope=cluster
+    serve the cluster-scope shapes on a standalone node."""
+    import urllib.request
+
+    from distributed_sudoku_solver_tpu.serving.http import (
+        ApiServer,
+        StandaloneNode,
+    )
+
+    def get(api, path):
+        url = f"http://127.0.0.1:{api.port}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def post_solve(api):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{api.port}/solve",
+            data=json.dumps({"sudoku": np.asarray(EASY_9).tolist()}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 201
+
+    rec = trace.TraceRecorder(dump_dir=str(tmp_path))
+    # Any real solve blows a 1 ns p95 objective: the burst is induced by
+    # construction, and every HTTP response is a "slow" observation on
+    # the solve stream (fed by the /solve terminals, not the engine).
+    mon = slo.SloMonitor(
+        slo.parse_slo("solve_p95_ms<=0.000001"), min_samples=3,
+    )
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=4).start()
+    mon.metrics_fn = eng.metrics
+    api = ApiServer(StandaloneNode(eng), host="127.0.0.1", port=0).start()
+    try:
+        with trace.installed(rec), slo.installed(mon):
+            code, body = get(api, "/slo")
+            assert code == 200 and body["burning"] is False
+            for _ in range(4):
+                post_solve(api)
+            code, body = get(api, "/slo")
+            assert code == 200
+            assert body["burning"] is True
+            obj = body["objectives"]["solve_p95_ms<=0.000001"]
+            assert obj["burn_rate"] >= 1.0 and obj["breaches"] == 1
+            dumps = [f for f in os.listdir(tmp_path) if "slo_burn" in f]
+            assert len(dumps) == 1, "exactly one dump per crossing"
+
+            code, st = get(api, "/status")
+            assert code == 200
+            assert st["healthy"] is False and st["degraded"] is False
+            assert st["slo"]["burning"] is True
+            assert "latency_ms" in st["quantiles"]
+
+            code, cm = get(api, "/metrics?scope=cluster")
+            assert code == 200 and cm["scope"] == "cluster"
+            (only,) = cm["nodes"].values()
+            assert only["unreachable"] is False
+            ru = cm["rollup"]
+            assert ru["nodes"] == 1 and ru["unreachable"] == 0
+            assert ru["hist"]["latency_ms"]["counts"] == only["metrics"][
+                "hist"
+            ]["latency_ms"]["counts"]
+
+            # Federated Prometheus form passes the lint.
+            raw = (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{api.port}"
+                    "/metrics?scope=cluster&format=prometheus",
+                    timeout=60,
+                )
+                .read()
+                .decode()
+            )
+            assert promck.check_text(raw) == [], promck.check_text(raw)[:5]
+            assert 'dsst_cluster_rollup_hist_latency_ms_bucket{le="+Inf"}' in raw
+            assert "dsst_cluster_nodes_unreachable{" in raw
+        # Seams uninstalled: /slo 404s again.
+        code, _ = get(api, "/slo")
+        assert code == 404
+    finally:
+        api.stop()
+        eng.stop(timeout=2)
+
+
+# -- aggregation unit lane -----------------------------------------------------
+
+
+def _body(latencies_ms, jobs_done, floor_ms=None):
+    h = hist.LatencyHistogram()
+    for v in latencies_ms:
+        h.record(v / 1e3)
+    body = {"jobs_done": jobs_done, "solved": jobs_done,
+            "hist": {"latency_ms": h.to_dict()}}
+    if floor_ms is not None:
+        body["rpc_floor_ms"] = {"type": "min_est", "min": floor_ms,
+                                "recent": floor_ms, "samples": 10}
+    return body
+
+
+def test_agg_rollup_merges_hists_counters_and_floor():
+    a = _body([10, 20, 30], jobs_done=3, floor_ms=50.0)
+    b = _body([40], jobs_done=1, floor_ms=45.0)
+    ru = agg.rollup([a, b, None, "garbage"])  # degraded entries skipped
+    assert hist.hist_count(ru["hist"]["latency_ms"]) == 4
+    assert ru["hist"]["latency_ms"]["counts"] == [
+        x + y
+        for x, y in zip(
+            a["hist"]["latency_ms"]["counts"], b["hist"]["latency_ms"]["counts"]
+        )
+    ]
+    assert ru["counters"] == {"jobs_done": 4, "solved": 4}
+    assert ru["rpc_floor_ms"]["min"] == pytest.approx(45.0)
+    q = ru["quantiles"]["latency_ms"]
+    assert q["count"] == 4 and 0 < q["p50_ms"] <= q["p95_ms"]
+
+
+def test_status_from_reflects_degradation_and_slo():
+    cm = {
+        "address": "a:1", "coordinator": "a:1", "view": [1, 2],
+        "nodes": {
+            "a:1": {"stale": False, "unreachable": False, "metrics": {}},
+            "b:2": {"stale": True, "unreachable": False, "metrics": {}},
+            "c:3": {"stale": False, "unreachable": True, "metrics": None},
+        },
+        "rollup": {"quantiles": {}, "counters": {}},
+    }
+    st = agg.status_from(cm)
+    assert st["degraded"] is True and st["healthy"] is False
+    assert st["members"]["b:2"]["stale"] is True
+    assert st["members"]["c:3"]["unreachable"] is True
+    assert st["unreachable"] == 1 and st["slo"] is None
+
+
+def test_status_from_sees_member_slo_burning():
+    """Review finding: a MEMBER burning its budget is a cluster problem —
+    the pulled bodies carry each node's slo section, and /status must
+    not report healthy off the serving node's local monitor alone."""
+    cm = {
+        "address": "a:1", "coordinator": "a:1", "view": [1, 2],
+        "nodes": {
+            "a:1": {"stale": False, "unreachable": False,
+                    "metrics": {"slo": {"burning": False}}},
+            "b:2": {"stale": False, "unreachable": False,
+                    "metrics": {"slo": {"burning": True}}},
+        },
+        "rollup": {"quantiles": {}, "counters": {}},
+    }
+    st = agg.status_from(cm)
+    assert st["slo_burning_members"] == ["b:2"]
+    assert st["healthy"] is False and st["degraded"] is False
+
+
+# -- promck unit lane ----------------------------------------------------------
+
+GOOD = """\
+dsst_jobs 4
+dsst_lat_bucket{le="1"} 1
+dsst_lat_bucket{le="2"} 3
+dsst_lat_bucket{le="+Inf"} 4
+dsst_lat_sum 7.5
+dsst_lat_count 4
+dsst_state{geometry="9x9",state="open"} 1
+"""
+
+
+def test_promck_accepts_wellformed_exposition():
+    assert promck.check_text(GOOD) == []
+    assert promck.check_text("") == []
+
+
+def test_promck_rejects_duplicates_and_bad_labels():
+    errs = promck.check_text("dsst_x 1\ndsst_x 1\n")
+    assert any("duplicate series" in e for e in errs)
+    # Same name, different labels: NOT a duplicate.
+    assert promck.check_text('dsst_x{a="1"} 1\ndsst_x{a="2"} 1\n') == []
+    # Label order must not defeat the duplicate check.
+    errs = promck.check_text('dsst_x{a="1",b="2"} 1\ndsst_x{b="2",a="1"} 1\n')
+    assert any("duplicate series" in e for e in errs)
+    errs = promck.check_text('dsst_x{v="a"b"} 1\n')
+    assert any("unescaped" in e or "malformed" in e for e in errs)
+    errs = promck.check_text('dsst_x{v="a",v="b"} 1\n')
+    assert any("duplicate label name" in e for e in errs)
+    errs = promck.check_text("dsst_x one\n")
+    assert any("value" in e for e in errs)
+    assert promck.check_text('dsst_x{v="esc\\"ok\\n"} 1\n') == []
+
+
+def test_promck_rejects_broken_histograms():
+    non_mono = (
+        'dsst_h_bucket{le="1"} 5\n'
+        'dsst_h_bucket{le="2"} 3\n'
+        'dsst_h_bucket{le="+Inf"} 6\n'
+    )
+    errs = promck.check_text(non_mono)
+    assert any("non-monotone" in e for e in errs)
+    no_inf = 'dsst_h_bucket{le="1"} 1\n'
+    errs = promck.check_text(no_inf)
+    assert any("+Inf" in e for e in errs)
+    # A second histogram family with different labels is independent.
+    two_geoms = (
+        'dsst_h_bucket{geometry="9x9",le="1"} 5\n'
+        'dsst_h_bucket{geometry="9x9",le="+Inf"} 6\n'
+        'dsst_h_bucket{geometry="16x16",le="1"} 1\n'
+        'dsst_h_bucket{geometry="16x16",le="+Inf"} 2\n'
+    )
+    assert promck.check_text(two_geoms) == []
+
+
+def test_promck_cli_roundtrip(tmp_path):
+    good = tmp_path / "good.txt"
+    good.write_text(GOOD)
+    assert promck.main([str(good)]) == 0
+    bad = tmp_path / "bad.txt"
+    bad.write_text("dsst_x 1\ndsst_x 2\n")
+    assert promck.main([str(bad)]) == 1
+    assert promck.main([]) == 2
+    assert promck.check_file(str(tmp_path / "missing.txt")) != []
+
+
+# -- bench regression gate -----------------------------------------------------
+
+
+def _load_regress():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "regress.py",
+    )
+    spec = importlib.util.spec_from_file_location("dsst_bench_regress", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifact(p50, p95, params=None):
+    side = {"p50_ms": p50, "p95_ms": p95, "p99_ms": p95 * 1.2,
+            "mean_ms": p50, "jobs": 48}
+    return {
+        "schema": "dsst-bench-poisson/1",
+        "params": params or {"jobs": 48, "mean_gap_ms": 50.0,
+                             "handicap_ms": 50.0, "chunk_steps": 8, "seed": 7},
+        "static": dict(side),
+        "resident": dict(side),
+        "speedups": {"p50": 1.0, "p95": 1.0, "p99": 1.0},
+        "rpc_floor_ms": {"type": "min_est", "min": 50.0, "recent": 50.0,
+                         "samples": 100},
+        "hist": {},
+    }
+
+
+def test_regress_gate_exit_codes(tmp_path):
+    regress = _load_regress()
+
+    def write(name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    base = write("base.json", _artifact(100.0, 400.0))
+    same = write("same.json", _artifact(110.0, 420.0))  # inside 25% noise
+    worse = write("worse.json", _artifact(100.0, 600.0))  # p95 +50%
+    better = write("better.json", _artifact(50.0, 200.0))
+    other = write(
+        "other.json",
+        _artifact(100.0, 400.0, params={"jobs": 16, "mean_gap_ms": 50.0,
+                                        "handicap_ms": 50.0,
+                                        "chunk_steps": 8, "seed": 7}),
+    )
+    assert regress.main([base, same]) == 0
+    assert regress.main([base, worse]) == 1
+    assert regress.main([base, better]) == 0
+    assert regress.main([base, other]) == 2  # different workloads
+    assert regress.main([base, str(tmp_path / "missing.json")]) == 2
+    rep = regress.compare(json.loads(open(base).read()),
+                          json.loads(open(worse).read()))
+    assert any("p95" in r for r in rep["regressions"])
+
+
+def test_bench_artifact_schema_matches_regress_expectations():
+    """The artifact bench_poisson --out-json writes and the gate's schema
+    constant must not drift apart (they live in different files)."""
+    import re
+
+    src = open(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks", "bench_poisson.py",
+        )
+    ).read()
+    m = re.search(r'"schema": "([^"]+)"', src)
+    assert m and m.group(1) == _load_regress().SCHEMA
+
+
+# -- simnet acceptance ---------------------------------------------------------
+
+
+def _seed_samples(engines):
+    """Deterministic histogram samples, distinct per node: node i records
+    (i+1) samples at 2^i ms into sync_wall_ms — input the solver's wall
+    clock never touches, so two runs must produce bit-identical merges."""
+    for i, eng in enumerate(engines):
+        for _ in range(i + 1):
+            eng.hist["sync_wall_ms"].record((2.0 ** i) / 1e3)
+        eng.rpc_floor.record((2.0 ** i) / 1e3)
+
+
+def _ring3(net, cfg):
+    from distributed_sudoku_solver_tpu.cluster.node import ClusterNode
+    from distributed_sudoku_solver_tpu.cluster.simnet import wait_until
+
+    from tests.test_cluster import oracle_solve_fn
+
+    engines = [
+        SolverEngine(solve_fn=oracle_solve_fn(), batch_window_s=0.001).start()
+        for _ in range(3)
+    ]
+    a = ClusterNode(engines[0], config=cfg, transport=net.transport(),
+                    clock=net.clock).start()
+    b = ClusterNode(engines[1], anchor=a.addr, config=cfg,
+                    transport=net.transport(), clock=net.clock).start()
+    c = ClusterNode(engines[2], anchor=a.addr, config=cfg,
+                    transport=net.transport(), clock=net.clock).start()
+    nodes = [a, b, c]
+    assert wait_until(
+        net, lambda: all(len(n.network) == 3 for n in nodes), timeout=60
+    ), "ring never formed"
+    return engines, nodes
+
+
+def _cluster_cfg():
+    from distributed_sudoku_solver_tpu.cluster.node import ClusterConfig
+
+    return ClusterConfig(
+        heartbeat_s=0.25, fail_factor=50.0, io_timeout_s=2.0,
+        needwork=False, progress_interval_s=0.0, stats_timeout_s=2.0,
+    )
+
+
+@pytest.mark.simnet
+def test_cluster_scope_merge_sums_and_is_deterministic():
+    """Acceptance: on a 3-node simnet ring, GET /metrics?scope=cluster's
+    rollup histogram counts equal the vector sum of the per-node counts —
+    and a seeded phase merges bit-identically across two fully
+    independent runs on the virtual clock."""
+    from distributed_sudoku_solver_tpu.cluster.simnet import SimNet, wait_until
+
+    net_views = []
+    for _ in range(2):
+        net = SimNet()
+        engines, nodes = _ring3(net, _cluster_cfg())
+        a = nodes[0]
+        try:
+            # Real traffic through the ring (remote dispatch populates the
+            # wire histograms), then the deterministic seeded phase.
+            jobs = [
+                a._submit_remote(np.asarray(EASY_9, np.int32), n.addr_s)
+                for n in nodes[1:]
+            ]
+            assert wait_until(
+                net, lambda: all(j.done.is_set() for j in jobs), timeout=120
+            ), "remote jobs never resolved"
+            assert all(j.solved for j in jobs)
+            _seed_samples(engines)
+            cm = a.cluster_metrics_view()
+
+            # Every member reachable, none stale, and the rollup is the
+            # vector sum of the per-node histogram counts — per phase.
+            assert len(cm["nodes"]) == 3
+            assert all(
+                not n["unreachable"] and not n["stale"]
+                for n in cm["nodes"].values()
+            )
+            for phase, merged in cm["rollup"]["hist"].items():
+                per_node = [
+                    n["metrics"]["hist"][phase]["counts"]
+                    for n in cm["nodes"].values()
+                    if phase in n["metrics"].get("hist", {})
+                ]
+                vec_sum = [sum(col) for col in zip(*per_node)]
+                assert merged["counts"] == vec_sum, phase
+            # The seeded phase: 1+2+3 samples across known buckets.
+            seeded = cm["rollup"]["hist"]["sync_wall_ms"]
+            assert hist.hist_count(seeded) == 6
+            # Cluster floor = min of member floors = 1 ms (node 0's seed).
+            assert cm["rollup"]["rpc_floor_ms"]["min"] == pytest.approx(1.0)
+            # Aggregation counters exported under cluster.agg.
+            mv = a.metrics_view()
+            assert mv["cluster"]["agg"]["pulls"] == 2
+            assert mv["cluster"]["agg"]["merges"] == 1
+            assert mv["cluster"]["agg"]["unreachable_peers"] == 0
+            net_views.append(seeded["counts"])
+        finally:
+            for n in nodes:
+                n.kill()
+            for e in engines:
+                e.stop(timeout=1)
+            net.close()
+    assert net_views[0] == net_views[1], (
+        "cluster-scope merge not deterministic across two virtual-clock runs"
+    )
+
+
+@pytest.mark.simnet
+def test_partitioned_member_flagged_unreachable_without_blocking(caplog):
+    """Acceptance: the pull completes while a member is partitioned — the
+    member is flagged unreachable (and the degradation logged with the
+    peer identified), the reachable majority still merges."""
+    from distributed_sudoku_solver_tpu.cluster.simnet import SimNet
+
+    net = SimNet()
+    engines, nodes = _ring3(net, _cluster_cfg())
+    a, b, c = nodes
+    try:
+        _seed_samples(engines)
+        net.partition([c.addr_s], [a.addr_s, b.addr_s])
+        with caplog.at_level(logging.WARNING):
+            cm = a.cluster_metrics_view()
+        assert cm["nodes"][c.addr_s]["unreachable"] is True
+        assert cm["nodes"][c.addr_s]["metrics"] is None
+        assert cm["nodes"][b.addr_s]["unreachable"] is False
+        assert cm["rollup"]["unreachable"] == 1
+        # Rollup covers exactly the reachable members (nodes 0 and 1:
+        # 1 + 2 seeded sync samples).
+        assert hist.hist_count(cm["rollup"]["hist"]["sync_wall_ms"]) == 3
+        assert a.agg_unreachable == 1
+        assert any(
+            f"[peer {c.addr_s}]" in r.getMessage() for r in caplog.records
+        ), "degraded aggregation must log the peer"
+        # /status derives the degradation honestly.
+        st = agg.status_from(cm)
+        assert st["degraded"] is True and st["healthy"] is False
+        # A stale member: bump our epoch so b's reply view disagrees.
+        with a._lock:
+            a.net_epoch += 1
+        cm2 = a.cluster_metrics_view()
+        assert cm2["nodes"][b.addr_s]["stale"] is True
+        assert cm2["nodes"][b.addr_s]["metrics"] is not None  # still merged
+    finally:
+        for n in nodes:
+            n.kill()
+        for e in engines:
+            e.stop(timeout=1)
+        net.close()

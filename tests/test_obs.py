@@ -303,6 +303,39 @@ PROM_SAMPLE = {
     "healthy": True,
     "nothing": None,
     "empty": {},
+    # Round-12 cluster-scope sections: a mergeable log2 histogram (renders
+    # as cumulative le buckets + _sum/_count; exemplars stay JSON-only),
+    # the rpc-floor estimate, and the SLO plane (objectives label dict).
+    "hist": {
+        "solve_ms": {
+            "type": "log2_hist",
+            "edge0_ms": 0.001,
+            "counts": [0] * 10 + [3, 1] + [0] * 19 + [1],
+            "sum_ms": 3105.2,
+            "exemplars": {"11": "1f2e3d4c"},
+        },
+    },
+    "rpc_floor_ms": {"type": "min_est", "min": 48.9, "recent": 50.2,
+                     "samples": 210},
+    "slo": {
+        "burn_threshold": 1.0,
+        "window_s": 60,
+        "burning": False,
+        "burns": 1,
+        "dumps": 1,
+        "objectives": {
+            "solve_p95_ms<=250": {
+                "stream": "solve",
+                "budget": 0.05,
+                "threshold": 250.0,
+                "burn_rate": 0.4,
+                "burning": False,
+                "breaches": 1,
+                "window_total": 100,
+                "window_bad": 2,
+            },
+        },
+    },
 }
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "data", "prometheus_golden.txt")
@@ -323,6 +356,49 @@ def test_prometheus_render_escapes_and_shapes():
     assert "dsst_n 1.25" in out
     assert out.endswith("\n")
     assert prom.render({}) == ""
+
+
+def test_prometheus_sample_passes_promck():
+    """The renderer and the lint agree on the whole rule surface: the
+    golden sample (every flattening rule incl. the histogram/SLO series)
+    must come out the other side clean."""
+    from distributed_sudoku_solver_tpu.obs import promck
+
+    assert promck.check_text(prom.render(PROM_SAMPLE)) == []
+
+
+def test_promck_over_live_prometheus_endpoint():
+    """Satellite: the LIVE ``GET /metrics?format=prometheus`` body — with
+    the histogram sections populated by a real solve — passes promck."""
+    import urllib.request
+
+    from distributed_sudoku_solver_tpu.obs import promck
+    from distributed_sudoku_solver_tpu.serving.http import (
+        ApiServer,
+        StandaloneNode,
+    )
+
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=4).start()
+    api = ApiServer(StandaloneNode(eng), host="127.0.0.1", port=0).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+        raw = (
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{api.port}/metrics?format=prometheus",
+                timeout=30,
+            )
+            .read()
+            .decode()
+        )
+    finally:
+        api.stop()
+        eng.stop(timeout=2)
+    assert promck.check_text(raw) == [], promck.check_text(raw)[:5]
+    # The histogram plane is live: cumulative buckets ending at +Inf.
+    assert 'dsst_hist_latency_ms_bucket{le="+Inf"}' in raw
+    assert "dsst_hist_latency_ms_count" in raw
+    assert "dsst_rpc_floor_ms_min" in raw
 
 
 # -- simnet acceptance ---------------------------------------------------------
