@@ -22,11 +22,46 @@ Layer map (TPU-native re-design of SURVEY.md §1):
 
 __version__ = "0.1.0"
 
-from distributed_sudoku_solver_tpu.models.geometry import (  # noqa: F401
-    Geometry,
-    SUDOKU_4,
-    SUDOKU_9,
-    SUDOKU_16,
-    SUDOKU_25,
-    geometry_for_size,
+# Lazy top-level exports (PEP 562): importing the bare package must stay
+# jax-free.  The geometry conveniences used to be eager, which pulled
+# jax.numpy into EVERY `import distributed_sudoku_solver_tpu` — including
+# `python -m distributed_sudoku_solver_tpu.analysis`, whose whole
+# contract is "stdlib ast, <5 s, no jax import" (tests/test_analysis.py
+# pins it).  `from distributed_sudoku_solver_tpu import Geometry` still
+# works; it just resolves on first touch.
+_GEOMETRY_EXPORTS = (
+    "Geometry",
+    "SUDOKU_4",
+    "SUDOKU_9",
+    "SUDOKU_16",
+    "SUDOKU_25",
+    "geometry_for_size",
 )
+
+
+def __getattr__(name: str):
+    if name in _GEOMETRY_EXPORTS:
+        from distributed_sudoku_solver_tpu.models import geometry
+
+        return getattr(geometry, name)
+    # Attribute-style subpackage access (`pkg.models` after a bare
+    # `import distributed_sudoku_solver_tpu`) used to work as a side
+    # effect of the eager geometry import; keep it working lazily.
+    import importlib
+
+    try:
+        return importlib.import_module(f"{__name__}.{name}")
+    except ModuleNotFoundError as e:
+        if e.name == f"{__name__}.{name}":
+            # The submodule itself does not exist: a genuine attribute
+            # miss.  Anything else (e.g. jax absent inside an existing
+            # submodule) is a real import failure and must surface as
+            # one, not be masked as an AttributeError.
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}"
+            ) from None
+        raise
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_GEOMETRY_EXPORTS))

@@ -1,0 +1,48 @@
+"""Static invariant linter: the repo's contracts, proved on every line.
+
+``obs/traceck.py`` and ``obs/promck.py`` lint the system's *output*
+(trace JSON, Prometheus exposition); this package is the same discipline
+aimed at the *source*.  Four AST-based rules behind one runner::
+
+    python -m distributed_sudoku_solver_tpu.analysis [--json] [--rule R]
+                                                     [--scope benchmarks]
+
+* **layerck** — the import-layering manifest (``manifest.LAYERS``):
+  ``obs/``, ``serving/faults.py``, ``cluster/wire.py``,
+  ``cluster/simnet.py`` are closed layers (stdlib + declared siblings,
+  never importing serving back); ``ops``/``models`` never import
+  ``serving``/``cluster``.  Checks real import nodes, nested-in-function
+  lazy imports included.
+* **clockck** — bare ``time.time``/``time.monotonic``/``time.sleep``/
+  ``datetime.now`` CALLS banned in ``cluster/``, ``serving/``, ``obs/``
+  outside the declared seams (``wire.SystemClock``, simnet's settling
+  internals); ``clock=...`` defaults *referencing* them are the injection
+  seam and pass.  The static, whole-tree form of the simnet runtime
+  guard, which imports its banned-name list from ``manifest`` (one list,
+  two lanes).
+* **syncck** — device-sync-forcing calls in the serving hot loops must
+  route through the ``host_fetch`` seam or prove their operand host-side
+  (a small dataflow pass over ``host_fetch``/``unpack_status`` results).
+* **lockck** — attributes declared ``# lockck: guard(_lock)`` are only
+  written under ``with <base>._lock:`` (or in ``*_locked`` helpers).
+
+Waiver grammar (all rules): a trailing ``# <rule>: allow(<reason>)`` on
+the flagged line, or on the enclosing ``def`` line to waive a whole
+function.  The reason string is mandatory; waived findings are reported
+(and carried in ``--json``) but do not fail the run.
+
+Exit codes are the *ck-family contract* (``obs/exitcodes.py``): 0 clean,
+1 violations, 2 internal/usage error.  Stdlib-``ast`` only — the runner
+never imports jax, and tier-1 (``tests/test_analysis.py``) pins both
+that and a clean exit over the package tree.
+"""
+
+from distributed_sudoku_solver_tpu.analysis.common import (  # noqa: F401
+    Finding,
+    RULES,
+)
+from distributed_sudoku_solver_tpu.obs.exitcodes import (  # noqa: F401
+    EXIT_CLEAN,
+    EXIT_INTERNAL,
+    EXIT_VIOLATIONS,
+)

@@ -1,0 +1,163 @@
+"""Runner: ``python -m distributed_sudoku_solver_tpu.analysis``.
+
+Checks the package tree (default) or ``--scope benchmarks``
+(report-only: benchmark scripts ARE wall-clock tools, so clock findings
+there inform rather than gate — documented in the README).  ``--rule``
+narrows to one or more rules, and the exit code then reflects exactly
+the selected rules — the "per-rule exit codes" contract: a CI step can
+gate on one rule while another is still being burned down.
+
+Deterministic by construction: sorted file walk, sorted findings,
+``sort_keys`` JSON — two runs over the same tree are byte-identical
+(pinned by tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from distributed_sudoku_solver_tpu.analysis import clockck, layerck, lockck, syncck
+from distributed_sudoku_solver_tpu.analysis import manifest
+from distributed_sudoku_solver_tpu.analysis.common import (
+    RULES,
+    Finding,
+    iter_sources,
+)
+from distributed_sudoku_solver_tpu.obs.exitcodes import (
+    EXIT_CLEAN,
+    EXIT_INTERNAL,
+    EXIT_VIOLATIONS,
+)
+
+_PACKAGE_DIR = Path(__file__).resolve().parent.parent
+
+
+def run(
+    root: Optional[Path] = None,
+    scope: str = "package",
+    rules: Tuple[str, ...] = RULES,
+) -> Tuple[dict, List[Finding]]:
+    """Run the selected rules; returns (json-ready report, findings)."""
+    if scope == "benchmarks":
+        root = root or _PACKAGE_DIR.parent / "benchmarks"
+        package_root = None
+        clock_all = True  # no package-relative dirs out there: scan all
+    else:
+        root = root or _PACKAGE_DIR
+        package_root = root
+        clock_all = False
+    mods = list(iter_sources(root, package_root))
+    findings: List[Finding] = []
+    if "layerck" in rules:
+        for mod in mods:
+            findings.extend(layerck.check_module(mod, manifest.LAYERS))
+    if "clockck" in rules:
+        for mod in mods:
+            findings.extend(clockck.check_module(
+                mod,
+                manifest.CLOCK_SCOPED_DIRS,
+                manifest.CLOCK_BANNED_CALLS,
+                manifest.CLOCK_SEAMS,
+                scope_all=clock_all,
+            ))
+    if "syncck" in rules:
+        for mod in mods:
+            findings.extend(syncck.check_module(
+                mod,
+                manifest.SYNC_SCOPED_FILES,
+                manifest.SYNC_HOT_REGIONS,
+                manifest.SYNC_SEAM_FUNCS,
+                manifest.SYNC_HOST_SOURCES,
+                manifest.SYNC_NUMPY_CALLS,
+                manifest.SYNC_METHOD_CALLS,
+                manifest.SYNC_JAX_CALLS,
+            ))
+    if "lockck" in rules:
+        findings.extend(lockck.check_modules(mods))
+    findings.sort()
+    report = {
+        "scope": scope,
+        "rules": {
+            rule: {
+                "violations": [
+                    f.to_dict() for f in findings
+                    if f.rule == rule and not f.waived
+                ],
+                "waived": [
+                    f.to_dict() for f in findings
+                    if f.rule == rule and f.waived
+                ],
+            }
+            for rule in sorted(rules)
+        },
+        "files_scanned": len(mods),
+    }
+    return report, findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_sudoku_solver_tpu.analysis",
+        description="AST-based invariant linter (layerck/clockck/syncck/lockck)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine report")
+    parser.add_argument(
+        "--rule", action="append", choices=RULES,
+        help="run only this rule (repeatable); exit code reflects it alone",
+    )
+    parser.add_argument(
+        "--scope", choices=("package", "benchmarks"), default="package",
+        help="'benchmarks' scans benchmarks/ report-only (always exits 0 "
+        "unless the tool itself fails)",
+    )
+    parser.add_argument(
+        "--root", type=Path, default=None, help=argparse.SUPPRESS
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors already — keep its semantics,
+        # but normalise --help's 0.
+        return EXIT_INTERNAL if e.code else EXIT_CLEAN
+    rules = tuple(args.rule) if args.rule else RULES
+    try:
+        report, findings = run(root=args.root, scope=args.scope, rules=rules)
+    except Exception:  # noqa: BLE001 - the tool failing is exit 2, loudly
+        traceback.print_exc()
+        return EXIT_INTERNAL
+    if report["files_scanned"] == 0:
+        # A typo'd --root (or a pip install with no benchmarks/ next to
+        # the package) must not report success while checking nothing.
+        print(
+            "analysis: no Python files found under the scan root "
+            f"[scope={args.scope}] — refusing to report a clean tree",
+            file=sys.stderr,
+        )
+        return EXIT_INTERNAL
+    violations = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render(), file=sys.stderr if not f.waived else sys.stdout)
+        for rule in sorted(rules):
+            nv = sum(1 for f in violations if f.rule == rule)
+            nw = sum(1 for f in waived if f.rule == rule)
+            print(f"analysis: {rule}: {nv} violation(s), {nw} waived")
+        print(
+            f"analysis: {len(violations)} violation(s) over "
+            f"{report['files_scanned']} files [scope={args.scope}]"
+        )
+    if args.scope == "benchmarks":
+        return EXIT_CLEAN  # report-only lane (see --scope help)
+    return EXIT_VIOLATIONS if violations else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,172 @@
+"""The repo's invariant manifest: the contracts the checkers prove.
+
+Everything here is DATA — the one place where the layering promises the
+module docstrings make, the clock seams the simnet lane trusts, the
+host-sync seam the round-8 rewrite paid for, and the lock conventions the
+review rounds kept re-finding by hand, are written down once and enforced
+by ``python -m distributed_sudoku_solver_tpu.analysis`` (see the package
+docstring for the waiver grammar).
+
+A plain Python dict/tuple module on purpose (ISSUE 10 allows
+``layers.toml`` *or* a py dict): the container pins Python 3.10, which has
+no ``tomllib``, and ``tests/conftest.py`` imports the runtime banned list
+from here directly — one source of truth for the static AND runtime lanes.
+"""
+
+from __future__ import annotations
+
+# -- layerck -------------------------------------------------------------
+#
+# Keys are package-relative dotted module prefixes; the LONGEST matching
+# prefix wins (so ``serving.faults`` overrides ``serving``).  Two rule
+# shapes:
+#
+# * closed layer (``closed=True``): stdlib + the listed internal prefixes
+#   + the listed third-party roots ONLY.  An internal target matches an
+#   ``allow`` entry by dotted-prefix in either direction (importing the
+#   ``cluster`` package to reach ``cluster.wire`` is the same promise as
+#   importing ``cluster.wire``).
+# * open layer (``closed=False``): anything goes EXCEPT the ``forbid``
+#   dotted prefixes, minus the ``except`` carve-outs.
+#
+# The rules below are the docstring promises, verbatim:
+# obs/ is stdlib + its own siblings and never imports serving back
+# (obs/trace.py module note); serving/faults.py is stdlib-only and
+# imported by engine/scheduler/bulk/cluster, never importing back
+# (faults.py docstring); cluster/wire.py is the stdlib wire layer;
+# cluster/simnet.py is wire + the fault-schedule machinery and nothing
+# else (simnet.py docstring); ops/ and models/ are the compute layers and
+# never reach up into serving/cluster — with the ONE declared exception of
+# the ``serving.faults`` injection seam at ``bulk.dispatch``.
+LAYERS = {
+    "obs": {"closed": True, "allow": ("obs",), "third_party": ()},
+    "serving.faults": {"closed": True, "allow": (), "third_party": ()},
+    "cluster.wire": {"closed": True, "allow": (), "third_party": ()},
+    "cluster.simnet": {
+        "closed": True,
+        "allow": ("cluster.wire", "serving.faults"),
+        "third_party": (),
+    },
+    # The checker's own layer: source-only tooling.  stdlib + obs (the
+    # shared *ck exit-code contract) — importing jax here would break the
+    # "<5 s, no jax" acceptance the tier-1 test pins.
+    "analysis": {"closed": True, "allow": ("analysis", "obs"), "third_party": ()},
+    "ops": {
+        "closed": False,
+        "forbid": ("serving", "cluster", "analysis"),
+        "except": ("serving.faults",),
+    },
+    "models": {"closed": False, "forbid": ("serving", "cluster", "analysis")},
+    "parallel": {"closed": False, "forbid": ("serving", "cluster", "analysis")},
+    "utils": {"closed": False, "forbid": ("serving", "cluster", "analysis")},
+    "native": {"closed": False, "forbid": ("serving", "cluster", "analysis")},
+    # serving sits BELOW cluster (cluster/node.py imports serving.engine):
+    # a serving -> cluster import would be a cycle by construction.
+    "serving": {"closed": False, "forbid": ("cluster",)},
+}
+
+# -- clockck -------------------------------------------------------------
+#
+# Directories where bare wall-clock CALLS are banned: every timing
+# decision in these layers must route through an injected clock (the
+# ``clock=...`` parameter/field defaults that *reference* these functions
+# are the injection seam and are allowed — clockck flags calls, not
+# references).  This is the static, whole-tree form of the simnet runtime
+# guard's promise (tests/conftest.py).
+CLOCK_SCOPED_DIRS = ("cluster", "serving", "obs")
+
+# (module, attr) call targets that count as bare clock access.  The
+# whole spelling family, not just the four the docstrings name — a rule
+# that misses ``perf_counter()`` or ``monotonic_ns()`` is laundered by a
+# rename (review-round finding).
+CLOCK_BANNED_CALLS = (
+    ("time", "time"),
+    ("time", "time_ns"),
+    ("time", "monotonic"),
+    ("time", "monotonic_ns"),
+    ("time", "perf_counter"),
+    ("time", "perf_counter_ns"),
+    ("time", "sleep"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+)
+
+# Declared seams: qualname prefixes (per package-relative file) whose
+# bodies may touch the real clock.  These are the places whose WHOLE JOB
+# is to be the wall-clock boundary:
+# * wire.SystemClock — the production clock behind ClusterNode's
+#   injectable seam; late-bound on purpose so the runtime guard still
+#   catches a simnet test that forgot ``clock=net.clock``.
+# * SimNet.sleep/advance/settle — simnet's bounded REAL settling waits
+#   (never slept on; see the ``_monotonic`` import-time capture note in
+#   cluster/simnet.py).
+CLOCK_SEAMS = {
+    "cluster/wire.py": ("SystemClock",),
+    "cluster/simnet.py": ("SimNet.sleep", "SimNet.advance", "SimNet.settle"),
+}
+
+# The runtime twin (tests/conftest.py imports this): module attributes
+# monkeypatched to raise inside ``simnet``-marked tests.  Superset of the
+# sleep/monotonic half of CLOCK_BANNED_CALLS (pinned by
+# tests/test_analysis.py) plus the socket escapes — now including
+# select/selectors-level waits, which are sleeps and socket IO in one
+# call.  ``time.time`` is deliberately ABSENT from the runtime list:
+# logging.LogRecord reads it on every record, so a runtime ban would fail
+# any simnet test the moment a node logs — the static lane (clockck)
+# covers time.time instead.
+SIMNET_RUNTIME_BANNED = (
+    ("socket", "socket"),
+    ("socket", "create_connection"),
+    ("socket", "create_server"),
+    ("select", "select"),
+    ("selectors", "DefaultSelector"),
+    ("selectors", "SelectSelector"),
+    ("selectors", "PollSelector"),
+    ("selectors", "EpollSelector"),
+    ("selectors", "KqueueSelector"),
+    ("time", "sleep"),
+    ("time", "monotonic"),
+)
+
+# -- syncck --------------------------------------------------------------
+#
+# Files under the round-8 "one sync per chunk" contract, and within them
+# the hot-loop regions (qualname prefixes) where a device-sync-forcing
+# call must either route through the ``host_fetch`` seam
+# (serving/engine.py) or prove its operand host-side (assigned from a
+# ``host_fetch``/``unpack_status`` result — the checker tracks that
+# dataflow) or carry a ``# syncck: allow(<reason>)`` waiver.  Outside the
+# hot regions the same sync-forcing calls are still flagged (waiver
+# required), but the int()/float()-on-indexed-value heuristic only runs
+# inside hot regions — metrics/stats plumbing coerces host ints
+# everywhere and is not the hazard this rule hunts.
+SYNC_SCOPED_FILES = ("serving/engine.py", "serving/scheduler.py")
+
+SYNC_HOT_REGIONS = {
+    "serving/engine.py": (
+        "SolverEngine._advance_flight",
+        "SolverEngine._resolve_solved",
+        "SolverEngine._do_snapshot",
+        "SolverEngine._do_shed",
+    ),
+    "serving/scheduler.py": (
+        "ResidentFlight.step",
+        "ResidentFlight._consume_status",
+        "ResidentFlight._collect_and_detach",
+        "ResidentFlight._attach_pending",
+        "ResidentFlight._advance",
+    ),
+}
+
+# Functions whose BODY is the seam (exempt) and whose results prove their
+# targets host-side for the dataflow pass.
+SYNC_SEAM_FUNCS = ("host_fetch",)
+SYNC_HOST_SOURCES = ("host_fetch", "unpack_status")
+
+# numpy-module call names that force a device->host transfer when handed
+# a jax array (jnp.asarray is the opposite direction and exempt).
+SYNC_NUMPY_CALLS = ("asarray", "ascontiguousarray")
+# method calls that force a sync on any jax value.
+SYNC_METHOD_CALLS = ("item", "block_until_ready")
+# jax-module call names that ARE the sync primitive.
+SYNC_JAX_CALLS = ("device_get",)

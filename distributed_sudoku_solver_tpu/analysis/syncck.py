@@ -1,0 +1,222 @@
+"""syncck: host-sync discipline in the serving hot loops.
+
+Round 8 bought "exactly one host sync per chunk" and routed every
+device->host read through the ``host_fetch`` seam
+(serving/engine.py) — and a later review still caught a stray
+``np.asarray`` quietly re-adding ~100 ms/chunk through a tunneled
+device.  This rule makes that catch static:
+
+* in ``manifest.SYNC_SCOPED_FILES``, every sync-forcing call
+  (``np.asarray``/``np.ascontiguousarray`` on the numpy alias,
+  ``jax.device_get``, ``.item()``/``.block_until_ready()`` methods) is
+  flagged unless its operand is PROVEN host-side or it carries a
+  ``# syncck: allow(<reason>)`` waiver (line- or def-scoped);
+* inside the declared hot-loop regions (``manifest.SYNC_HOT_REGIONS``)
+  the heuristic widens to ``int(...)``/``float(...)`` over subscript/
+  attribute operands — the classic shape of a scalar fetch off a live
+  device value.
+
+"Proven host-side" is a small forward dataflow pass, not a type system:
+a name (or ``self.<attr>``, tracked class-wide) assigned from a
+``host_fetch``/``unpack_status`` call — including tuple unpacking — is
+host data, and so is anything re-assigned from an expression rooted at
+one.  ``np.asarray(solutions[slot])`` over a fetched verdict tuple passes
+without ceremony; ``np.asarray(state.top)`` over a live frontier does
+not.  The ``host_fetch`` function body itself is the seam and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from distributed_sudoku_solver_tpu.analysis.common import (
+    Finding,
+    QualnameVisitor,
+    SourceModule,
+    call_name,
+    expr_root,
+    finding,
+)
+
+
+def _is_host_source(node: ast.AST, host_sources: Tuple[str, ...]) -> bool:
+    """Does this expression produce host data by construction?  A call
+    whose (possibly dotted) name ends in a host-source function, applied
+    to anything — including ``unpack_status(host_fetch(...))``."""
+    if isinstance(node, ast.Call):
+        name = call_name(node).rsplit(".", 1)[-1]
+        return name in host_sources
+    return False
+
+
+def _host_attrs(tree: ast.Module, host_sources: Tuple[str, ...]) -> Set[str]:
+    """Class-wide pass: ``self.X = <host source>(...)`` anywhere marks
+    ``self.X`` host-side for the whole file (the scheduler's
+    ``self._status`` pattern)."""
+    attrs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_host_source(
+            node.value, host_sources
+        ):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    attrs.add(f"self.{t.attr}")
+    return attrs
+
+
+class _SyncVisitor(QualnameVisitor):
+    def __init__(
+        self,
+        mod: SourceModule,
+        hot_regions: Tuple[str, ...],
+        seam_funcs: Tuple[str, ...],
+        host_sources: Tuple[str, ...],
+        numpy_calls: Tuple[str, ...],
+        method_calls: Tuple[str, ...],
+        jax_calls: Tuple[str, ...],
+        np_aliases: Set[str],
+        jax_aliases: Set[str],
+        host_attrs: Set[str],
+    ):
+        super().__init__()
+        self.mod = mod
+        self.hot_regions = hot_regions
+        self.seam_funcs = seam_funcs
+        self.host_sources = host_sources
+        self.numpy_calls = numpy_calls
+        self.method_calls = method_calls
+        self.jax_calls = jax_calls
+        self.np_aliases = np_aliases
+        self.jax_aliases = jax_aliases
+        self.host_attrs = host_attrs
+        self.host_locals: List[Set[str]] = []  # one scope per function
+        self.findings: List[Finding] = []
+
+    # -- scope plumbing ------------------------------------------------------
+    def _visit_func(self, node) -> None:
+        self.stack.append(node.name)
+        self.def_lines.append(node.lineno)
+        self.host_locals.append(set())
+        if node.name not in self.seam_funcs:  # the seam body is exempt
+            self.generic_visit(node)
+        self.host_locals.pop()
+        self.def_lines.pop()
+        self.stack.pop()
+
+    def _in_hot_region(self) -> bool:
+        q = self.qualname
+        return any(q == r or q.startswith(r + ".") for r in self.hot_regions)
+
+    def _is_host(self, node: ast.AST) -> bool:
+        root = expr_root(node)
+        if root is None:
+            return False
+        if root in self.host_attrs:
+            return True
+        return any(root in scope for scope in self.host_locals)
+
+    def _mark_host(self, target: ast.AST) -> None:
+        if not self.host_locals:
+            return
+        if isinstance(target, ast.Name):
+            self.host_locals[-1].add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mark_host(elt)
+        elif (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            self.host_attrs.add(f"self.{target.attr}")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if _is_host_source(node.value, self.host_sources) or self._is_host(
+            node.value
+        ):
+            for t in node.targets:
+                self._mark_host(t)
+
+    # -- the checks ----------------------------------------------------------
+    def _flag(self, node: ast.Call, what: str) -> None:
+        self.findings.append(finding(
+            self.mod, "syncck", node,
+            f"{what} outside the host_fetch seam — route the value "
+            "through host_fetch (or prove it host-side / waive with "
+            "reason)",
+            def_lines=tuple(self.def_lines),
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        arg0 = node.args[0] if node.args else None
+        if isinstance(f, ast.Attribute):
+            if (
+                isinstance(f.value, ast.Name)
+                and f.value.id in self.np_aliases
+                and f.attr in self.numpy_calls
+            ):
+                if arg0 is None or not self._is_host(arg0):
+                    self._flag(node, f"sync-forcing call np.{f.attr}()")
+            elif (
+                isinstance(f.value, ast.Name)
+                and f.value.id in self.jax_aliases
+                and f.attr in self.jax_calls
+            ):
+                self._flag(node, f"sync primitive jax.{f.attr}()")
+            elif f.attr in self.method_calls and not self._is_host(f.value):
+                self._flag(node, f".{f.attr}() call")
+        elif (
+            isinstance(f, ast.Name)
+            and f.id in ("int", "float")
+            and self._in_hot_region()
+            and isinstance(arg0, (ast.Subscript, ast.Attribute))
+            and not self._is_host(arg0)
+        ):
+            self._flag(
+                node, f"hot-loop {f.id}() over an indexed/attribute value"
+            )
+        self.generic_visit(node)
+
+
+def check_module(
+    mod: SourceModule,
+    scoped_files: Tuple[str, ...],
+    hot_regions: Dict[str, Tuple[str, ...]],
+    seam_funcs: Tuple[str, ...],
+    host_sources: Tuple[str, ...],
+    numpy_calls: Tuple[str, ...],
+    method_calls: Tuple[str, ...],
+    jax_calls: Tuple[str, ...],
+) -> List[Finding]:
+    if mod.rel not in scoped_files:
+        return []
+    np_aliases: Set[str] = set()
+    jax_aliases: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                if al.name == "numpy":
+                    np_aliases.add(al.asname or "numpy")
+                elif al.name == "jax":
+                    jax_aliases.add(al.asname or "jax")
+    v = _SyncVisitor(
+        mod,
+        hot_regions.get(mod.rel, ()),
+        seam_funcs,
+        host_sources,
+        numpy_calls,
+        method_calls,
+        jax_calls,
+        np_aliases,
+        jax_aliases,
+        _host_attrs(mod.tree, host_sources),
+    )
+    v.visit(mod.tree)
+    return v.findings

@@ -75,6 +75,13 @@ _BETWEEN_GRACE_S = 0.25
 
 _AddrLike = Union[Addr, str]
 
+# Captured at import on purpose: the simnet purity guard
+# (tests/conftest.py, banned list from analysis/manifest.py) monkeypatches
+# ``time.monotonic`` itself during simnet-marked tests — a runtime-banned
+# module attribute — so the bounded REAL settling waits below must hold
+# the function object, not re-resolve it per call.  Never slept on.
+_monotonic = _time.monotonic
+
 
 def _addr_s(a: _AddrLike) -> str:
     return addr_str(a) if isinstance(a, tuple) else a
@@ -211,7 +218,7 @@ class SimNet:
             finally:
                 del self._sleepers[token]
                 if not self._closed:
-                    self._between[tid] = _time.monotonic()
+                    self._between[tid] = _monotonic()
                 self._cond.notify_all()
 
     def advance(self, dt: float = 0.05, settle: bool = True) -> None:
@@ -227,9 +234,9 @@ class SimNet:
             self._cond.notify_all()
             # Hand the CPU to woken sleepers (heartbeat loops): each
             # removes its entry on the way out of sleep().
-            real_deadline = _time.monotonic() + 2.0
+            real_deadline = _monotonic() + 2.0
             while any(d <= self._now for d in self._sleepers.values()):
-                if _time.monotonic() >= real_deadline:
+                if _monotonic() >= real_deadline:
                     break
                 self._cond.wait(0.005)
         for item in due:
@@ -242,7 +249,7 @@ class SimNet:
         its handler, the handler returned, and every woken sleeper (a
         heartbeat loop mid-beat) has re-entered its sleep — the yield point
         between a virtual step and the next predicate check."""
-        deadline = _time.monotonic() + real_timeout
+        deadline = _monotonic() + real_timeout
         with self._cond:
             while True:
                 while self._queue and self._queue[0][0] <= self._now:
@@ -251,7 +258,7 @@ class SimNet:
                     threading.Thread(
                         target=self._deliver, args=(item,), daemon=True
                     ).start()
-                now_r = _time.monotonic()
+                now_r = _monotonic()
                 for tid in [
                     t
                     for t, ts in self._between.items()

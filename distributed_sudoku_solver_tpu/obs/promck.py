@@ -21,7 +21,9 @@ Checks (returns a list of error strings; empty = well-formed):
   parse, cumulative counts are non-decreasing in ``le`` order, and the
   family ends with an ``le="+Inf"`` bucket.
 
-Stdlib only.
+Exit codes follow the *ck-family contract (``obs/exitcodes.py``): 0
+clean, 1 findings, 2 internal/usage error (bad invocation, unreadable
+input).  Stdlib only.
 """
 
 from __future__ import annotations
@@ -30,6 +32,12 @@ import math
 import re
 import sys
 from typing import List, Union
+
+from distributed_sudoku_solver_tpu.obs.exitcodes import (
+    EXIT_CLEAN,
+    EXIT_INTERNAL,
+    EXIT_VIOLATIONS,
+)
 
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _LINE = re.compile(rf"^({_NAME})(?:\{{(.*)\}})? (\S+)$")
@@ -129,10 +137,16 @@ def check_text(text: str) -> List[str]:
     return errors
 
 
+def _load(path: str) -> str:
+    """The one read path, shared by check_file and main so the two cannot
+    drift (the exit-code split lives at the callers)."""
+    with open(path) as f:
+        return f.read()
+
+
 def check_file(path: str) -> List[str]:
     try:
-        with open(path) as f:
-            text = f.read()
+        text = _load(path)
     except OSError as e:
         return [f"{path}: unreadable: {e}"]
     return check_text(text)
@@ -146,18 +160,26 @@ def main(argv: Union[List[str], None] = None) -> int:
             "<metrics.txt>",
             file=sys.stderr,
         )
-        return 2
-    errors = check_file(argv[0])
+        return EXIT_INTERNAL
+    # Unreadable input is the tool failing to check, not the exposition
+    # failing the check (exit-code contract, module docstring).
+    try:
+        text = _load(argv[0])
+    except OSError as e:
+        print(f"promck: {argv[0]}: unreadable: {e}", file=sys.stderr)
+        return EXIT_INTERNAL
+    errors = check_text(text)
     if errors:
         for e in errors:
             print(f"promck: {e}", file=sys.stderr)
-        return 1
-    with open(argv[0]) as f:
-        n = sum(
-            1 for ln in f if ln.strip() and not ln.startswith("#")
-        )
+        return EXIT_VIOLATIONS
+    n = sum(
+        1
+        for ln in text.splitlines()
+        if ln.strip() and not ln.startswith("#")
+    )
     print(f"promck: OK ({n} series)")
-    return 0
+    return EXIT_CLEAN
 
 
 if __name__ == "__main__":

@@ -18,7 +18,9 @@ Checks (returns a list of error strings; empty = well-formed):
   ``ts`` never decreases (Perfetto renders out-of-order slices as a
   corrupt-looking track).
 
-Stdlib only.
+Exit codes follow the *ck-family contract (``obs/exitcodes.py``): 0
+clean, 1 findings, 2 internal/usage error (bad invocation, unreadable
+input).  Stdlib only.
 """
 
 from __future__ import annotations
@@ -26,6 +28,12 @@ from __future__ import annotations
 import json
 import sys
 from typing import List, Union
+
+from distributed_sudoku_solver_tpu.obs.exitcodes import (
+    EXIT_CLEAN,
+    EXIT_INTERNAL,
+    EXIT_VIOLATIONS,
+)
 
 _ALLOWED_PH = {"X", "M", "i", "I"}
 
@@ -76,10 +84,16 @@ def check(doc) -> List[str]:
     return errors
 
 
+def _load(path: str):
+    """The one read-and-parse path, shared by check_file and main so the
+    two cannot drift (the exit-code split lives at the callers)."""
+    with open(path) as f:
+        return json.load(f)
+
+
 def check_file(path: str) -> List[str]:
     try:
-        with open(path) as f:
-            doc = json.load(f)
+        doc = _load(path)
     except (OSError, json.JSONDecodeError) as e:
         return [f"{path}: unreadable or not JSON: {e}"]
     return check(doc)
@@ -90,16 +104,23 @@ def main(argv: Union[List[str], None] = None) -> int:
     if len(argv) != 1:
         print("usage: python -m distributed_sudoku_solver_tpu.obs.traceck "
               "<trace.json>", file=sys.stderr)
-        return 2
-    errors = check_file(argv[0])
+        return EXIT_INTERNAL
+    # Unreadable input is the tool failing to check, not the trace
+    # failing the check (exit-code contract, module docstring).
+    try:
+        doc = _load(argv[0])
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"traceck: {argv[0]}: unreadable or not JSON: {e}",
+              file=sys.stderr)
+        return EXIT_INTERNAL
+    errors = check(doc)
     if errors:
         for e in errors:
             print(f"traceck: {e}", file=sys.stderr)
-        return 1
-    with open(argv[0]) as f:
-        n = len(json.load(f).get("traceEvents", []))
+        return EXIT_VIOLATIONS
+    n = len(doc.get("traceEvents", []))
     print(f"traceck: OK ({n} events)")
-    return 0
+    return EXIT_CLEAN
 
 
 if __name__ == "__main__":

@@ -48,7 +48,7 @@ import queue
 import threading
 import time
 import uuid as uuid_mod
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
@@ -92,6 +92,7 @@ def host_fetch(x, floor_s: float = 0.0, tag: str = "status"):
     """
     faults.fire("fetch." + tag)
     if floor_s:
+        # clockck: allow(simulated RPC floor: sleeping at the sync IS this seam's documented behavior)
         time.sleep(floor_s)
     return jax.device_get(x)
 
@@ -218,6 +219,7 @@ class SolverEngine:
         handicap_s: float = 0.0,
         resident=None,  # Optional[serving.scheduler.ResidentConfig]
         recovery: Optional[faults.RecoveryPolicy] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.config = config
         self.max_batch = max_batch
@@ -232,6 +234,14 @@ class SolverEngine:
         # a real tunnel because the loops dispatch ahead.  The legacy
         # solve_fn path sleeps per batch.
         self.handicap_s = handicap_s
+        # The engine's time source for latency windows, batch windows, and
+        # deadline math.  The DEFAULT binds the real monotonic clock at
+        # class-definition time (a parameter default, i.e. clockck's
+        # injection-seam shape), which also makes default-clock engines
+        # immune to the simnet purity guard's time.monotonic monkeypatch —
+        # engine device loops live outside the virtual clock by design
+        # (cluster/simnet.py `wait_until` pacing note).
+        self._clock = clock
         self._solve_fn = solve_fn or (
             lambda grids, geom, cfg: solve_batch(grids, geom, cfg)
         )
@@ -303,7 +313,7 @@ class SolverEngine:
         # the device loop; the dict itself is guarded by _lock.
         self.resident_config = resident
         self._resident: dict = {}  # Geometry -> ResidentFlight
-        self.resident_unfit = 0  # geometries the resident fused shape
+        self.resident_unfit = 0  # lockck: guard(_lock) — geometries the resident fused shape
         #   cannot serve (fell back to static flights at submit time)
         # Insertion-ordered so stale entries (cancels for jobs that already
         # finished or never arrive) can be pruned oldest-first.
@@ -334,7 +344,7 @@ class SolverEngine:
         self.fault_bisections = 0  # permanently-failing batches split
         self.fault_budget_exhausted = 0  # jobs failed out of retries
         self.fault_permanent = 0  # jobs failed on an isolated permanent fault
-        self.fault_bulk_retries = 0  # transient bulk-chunk re-dispatches (http)
+        self.fault_bulk_retries = 0  # lockck: guard(_lock) — transient bulk-chunk re-dispatches, bumped by HTTP handler threads
         self._bisect_seq = 0  # bisection group token source
         # Per-dispatch lane-occupancy histogram for fused flights (ROADMAP
         # 4b evidence): the kernel counts, per lane, how many in-kernel
@@ -388,13 +398,17 @@ class SolverEngine:
         resident admission queue is full: ``'fallback'`` (default) quietly
         uses a static flight, ``'reject'`` raises ``EngineSaturated`` — the
         HTTP layer's 429 + Retry-After backpressure."""
-        g = np.asarray(grid, dtype=np.int32)
+        g = np.asarray(grid, dtype=np.int32)  # syncck: allow(client input coercion at submit time — list/ndarray host data, not the hot loop)
         geom = geom or geometry_for_size(g.shape[0])
         if g.shape != (geom.n, geom.n):
             raise ValueError(f"grid shape {g.shape} does not match geometry {geom}")
         job = Job(
             uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom, config=config
         )
+        # Re-stamp on the ENGINE clock: the dataclass default factory is
+        # the real monotonic clock, which is only the same time source
+        # when no custom clock was injected.
+        job.submitted_at = self._clock()
         rec = trace.active()
         if rec is not None:
             job.trace_t0 = rec.now()
@@ -490,7 +504,7 @@ class SolverEngine:
         """Submit a job whose search space is given subtree roots (candidate
         rows uint32[R, h, w]) rather than a clue grid — the entry point for
         checkpoint resume and cluster mid-job offload."""
-        r = np.ascontiguousarray(np.asarray(roots, dtype=np.uint32))
+        r = np.ascontiguousarray(np.asarray(roots, dtype=np.uint32))  # syncck: allow(resume payload coercion at submit time — wire-decoded host rows, not the hot loop)
         if r.ndim != 3 or r.shape[1:] != (geom.n, geom.n):
             raise ValueError(f"roots shape {r.shape} does not match geometry {geom}")
         if r.shape[0] == 0:
@@ -502,6 +516,7 @@ class SolverEngine:
             roots=r,
             config=config,
         )
+        job.submitted_at = self._clock()  # engine-clock stamp, as in submit()
         rec = trace.active()
         if rec is not None:
             job.trace_t0 = rec.now()
@@ -721,9 +736,9 @@ class SolverEngine:
         except queue.Empty:
             return []
         jobs = [first]
-        deadline = time.monotonic() + self.batch_window_s
+        deadline = self._clock() + self.batch_window_s
         while len(jobs) < self.max_batch:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - self._clock()
             if remaining <= 0:
                 break
             try:
@@ -1137,7 +1152,9 @@ class SolverEngine:
         roots = np.zeros((bucket, n, n), np.uint32)
         job_of_root = np.full(bucket, -1, np.int32)
         grids = np.stack([job.grid for job in jobs])
-        roots[: len(jobs)] = np.asarray(encode_grid(jnp.asarray(grids), geom), np.uint32)
+        roots[: len(jobs)] = np.asarray(  # syncck: allow(launch-time frontier seeding: one encode fetch at flight birth, outside the chunk loop)
+            encode_grid(jnp.asarray(grids), geom), np.uint32
+        )
         job_of_root[: len(jobs)] = np.arange(len(jobs), dtype=np.int32)
         cfg = self._fit_fused(geom, cfg, cfg.resolve_lanes(bucket))
         rec = trace.active()
@@ -1186,7 +1203,7 @@ class SolverEngine:
         rec = trace.active()
         tr0 = rec.now() if rec is not None else 0.0
         live_uuids = ()  # the shared empty tuple: no per-chunk allocation
-        t_pass = time.monotonic()
+        t_pass = self._clock()
         # Mid-flight cancellation + deadline expiry: purge the jobs' lanes
         # in-graph (async dispatch — the purge rides the device queue ahead
         # of the next chunk).  Deadlines are engine-wide wall-clock
@@ -1194,7 +1211,7 @@ class SolverEngine:
         # keeps its guarantee here), enforced at chunk granularity like
         # cancels; both need only host-side data, so they never wait on a
         # status fetch.
-        now = time.monotonic()
+        now = self._clock()
         cancel_idx = self._peek_cancels(fl.jobs)
         expire_idx = [
             i
@@ -1246,7 +1263,7 @@ class SolverEngine:
         fl.chunks += 1
         prev_status = fl.pending_status
         fl.pending_status = status_dev
-        dispatch_s = time.monotonic() - t_pass
+        dispatch_s = self._clock() - t_pass
         self.dispatch_wall.record(dispatch_s)
         self.hist["dispatch_wall_ms"].record(dispatch_s)
         if rec is not None:
@@ -1264,12 +1281,12 @@ class SolverEngine:
         # frontier's padded job dimension (the bucket), not len(fl.jobs) —
         # padding rows are never seeded, so their bits stay False.
         tr1 = rec.now() if rec is not None else 0.0
-        t_sync = time.monotonic()
+        t_sync = self._clock()
         info = unpack_status(
             host_fetch(prev_status, floor_s=self.handicap_s),
             fl.state.solved.shape[0],
         )
-        sync_s = time.monotonic() - t_sync
+        sync_s = self._clock() - t_sync
         self.sync_wall.record(sync_s)
         self.hist["sync_wall_ms"].record(sync_s)
         self.rpc_floor.record(sync_s)
@@ -1279,7 +1296,7 @@ class SolverEngine:
                 node=self.trace_node, uuids=live_uuids,
                 steps=int(info["steps"]),
             )
-        wall = time.monotonic() - t_pass
+        wall = self._clock() - t_pass
         self.chunk_wall.record(wall)
         self._chunk_wall_total += wall
         steps_delta = info["steps"] - fl.steps_seen
@@ -1313,13 +1330,13 @@ class SolverEngine:
         fl.state = None
         fl.pending_status = None
         tr_ev = rec.now() if rec is not None else 0.0
-        t_ev = time.monotonic()
+        t_ev = self._clock()
         solutions, unsat, nodes, solved, sol_counts = host_fetch(
             (res.solution, res.unsat, res.nodes, res.solved, res.sol_count),
             floor_s=self.handicap_s,
             tag="finalize",
         )
-        fin_s = time.monotonic() - t_ev
+        fin_s = self._clock() - t_ev
         self.event_wall.record(fin_s)
         self.hist["event_wall_ms"].record(fin_s)
         if rec is not None:
@@ -1363,13 +1380,13 @@ class SolverEngine:
         ever serve interactively."""
         rec = trace.active()
         tr_ev = rec.now() if rec is not None else 0.0
-        t_ev = time.monotonic()
+        t_ev = self._clock()
         solutions, nodes = host_fetch(
             _flight_verdict_jit(fl.state),
             floor_s=self.handicap_s,
             tag="event",
         )
-        ev = time.monotonic() - t_ev
+        ev = self._clock() - t_ev
         self.event_wall.record(ev)
         self.hist["event_wall_ms"].record(ev)
         if rec is not None:
@@ -1391,7 +1408,7 @@ class SolverEngine:
             self._finish_job(job)
 
     def _finish_job(self, job: Job) -> None:
-        wall = time.monotonic() - job.submitted_at
+        wall = self._clock() - job.submitted_at
         self.latency.record(wall)
         if job.solved:
             self.solved_count += 1
@@ -1514,7 +1531,7 @@ class SolverEngine:
         return fl.jobs[i].uuid, rows, dataclasses.asdict(fl.config)
 
     # -- legacy one-dispatch path (solve_fn overrides) ------------------------
-    def _solve_group(
+    def _solve_group(  # syncck: allow(legacy one-dispatch path: solve_fn overrides return device values and blocking fetches are its documented semantics)
         self, geom: Geometry, group: list[Job], cfg: Optional[SolverConfig] = None
     ) -> None:
         cfg = cfg or self.config
@@ -1525,6 +1542,7 @@ class SolverEngine:
                 self._solve_group(geom, group[i : i + cfg.lanes], cfg)
             return
         if self.handicap_s:
+            # clockck: allow(slow-node simulator: the legacy solve_fn path charges its handicap per batch, by design)
             time.sleep(self.handicap_s)
         for job in group:
             if job.roots is not None:
@@ -1557,7 +1575,7 @@ class SolverEngine:
         # Optional field: oracle-backed test solve_fns don't produce it.
         sol_counts = np.asarray(getattr(res, "sol_count", solved.astype(np.int32)))
 
-        now = time.monotonic()
+        now = self._clock()
         rec = trace.active()
         mon = slo.active()
         for i, job in enumerate(group):
@@ -1642,7 +1660,7 @@ def _finalize_jit(state: Frontier):
     return _finalize(state)
 
 
-def _rows_of_job_host(state: Frontier, job_index: int) -> np.ndarray:
+def _rows_of_job_host(state: Frontier, job_index: int) -> np.ndarray:  # syncck: allow(callers pass a host_fetch-ed frontier; the asarray calls are numpy no-ops on host data)
     """All surviving subtree roots of one job: its lanes' tops + stack rows.
 
     Host-side numpy gather (engine-scale frontiers are a few MB); the result

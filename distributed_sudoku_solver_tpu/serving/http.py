@@ -99,8 +99,9 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from distributed_sudoku_solver_tpu.obs import agg, slo, trace
@@ -115,6 +116,13 @@ _ACCESS_LOG = logging.getLogger(__name__ + ".access")
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+
+    def _now(self) -> float:
+        """The server's injected wall clock (``ApiServer(clock=...)``):
+        request durations and solve deadlines are client-visible wall
+        time, timed through the one seam so clockck can prove no handler
+        grows a bare ``time.time()`` back."""
+        return self.server.clock()
 
     # Route table kept flat on purpose: few endpoints, like the reference.
     def do_POST(self):  # noqa: N802 (stdlib casing)
@@ -131,8 +139,6 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError):
             return self._send(400, {"error": "body must be JSON {'sudoku': [[...]]}"})
         node = self.server.solver_node
-        import time
-
         import numpy as np
 
         # Validate the grid up front: the portfolio path submits straight to
@@ -145,7 +151,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(
                 400, {"error": f"sudoku must be a square grid, got shape {g.shape}"}
             )
-        start = time.time()
+        start = self._now()
         rec = trace.active()
         t_http = rec.now() if rec is not None else 0.0
         timeout = self.server.solve_timeout_s
@@ -167,12 +173,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(400, {"error": str(e)})
             if res.winner is None:
                 if res.timed_out:
-                    self._record_solve(node, time.time() - start, 504)
+                    self._record_solve(node, self._now() - start, 504)
                     return self._send(504, {"error": "portfolio race timed out"})
                 # Every racer resolved without a verdict: a permanent
                 # budget/overflow failure, not a retryable timeout.
                 err = next((j.error for j in res.jobs if j.error), None)
-                self._record_solve(node, time.time() - start, 500)
+                self._record_solve(node, self._now() - start, 500)
                 return self._send(500, {"error": err or "search budget exhausted"})
             job = res.winner
             strategy = res.strategy
@@ -199,9 +205,9 @@ class _Handler(BaseHTTPRequestHandler):
             if not job.wait(timeout):
                 node.cancel(job.uuid)
                 self._trace_http(rec, t_http, job.uuid, 504)
-                self._record_solve(node, time.time() - start, 504)
+                self._record_solve(node, self._now() - start, 504)
                 return self._send(504, {"error": "solve timed out", "uuid": job.uuid})
-        duration = time.time() - start
+        duration = self._now() - start
         extra = {"strategy": strategy} if strategy is not None else {}
         if job.solved:
             status = 201
@@ -260,7 +266,6 @@ class _Handler(BaseHTTPRequestHandler):
         _do_shed``) and the count needs no cross-node merge.  The response
         carries ``"scope": "local"`` to surface that (ADVICE r3)."""
         import dataclasses
-        import time
 
         engine = getattr(node, "engine", None)
         if engine is None:
@@ -276,10 +281,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": str(e)})
         if not job.wait(timeout):
             engine.cancel(job.uuid)
-            self._record_solve(node, time.time() - start, 504)
+            self._record_solve(node, self._now() - start, 504)
             return self._send(504, {"error": "enumeration timed out"})
         if job.error:
-            self._record_solve(node, time.time() - start, 500)
+            self._record_solve(node, self._now() - start, 500)
             return self._send(500, {"error": job.error})
         body = {
             "count": int(job.sol_count),
@@ -287,7 +292,7 @@ class _Handler(BaseHTTPRequestHandler):
             # (unless a stack overflow dropped subtrees: then lower bound).
             "complete": bool(job.unsat and not job.cancelled),
             "solution": job.solution.tolist() if job.sol_count > 0 else None,
-            "duration": time.time() - start,
+            "duration": self._now() - start,
             "scope": "local",  # enumeration never distributes (see docstring)
         }
         self._record_solve(node, body["duration"], 200)
@@ -307,7 +312,7 @@ class _Handler(BaseHTTPRequestHandler):
         return race(node.engine, grid, DEFAULT_PORTFOLIO, timeout=timeout)
 
     def _solve_batch(self):
-        import time
+        import time  # the waived backoff sleep below; clock reads go through _now()
 
         import numpy as np
 
@@ -347,7 +352,7 @@ class _Handler(BaseHTTPRequestHandler):
         engine = getattr(self.server.solver_node, "engine", None)
         if engine is None:
             return self._send(500, {"error": "node has no engine"})
-        start = time.time()
+        start = self._now()
         deadline = start + self.server.solve_timeout_s
         solved = np.zeros(len(grids), bool)
         unsat = np.zeros(len(grids), bool)
@@ -366,14 +371,14 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     res = engine.run_exclusive(
                         lambda sl=sl: solve_bulk(sl, geom, cfg),
-                        timeout=max(1.0, deadline - time.time()),
+                        timeout=max(1.0, deadline - self._now()),
                     )
                     break
                 except RuntimeError as e:
                     if (
                         faults.classify_message(str(e)) == faults.TRANSIENT
                         and attempts < engine.recovery.max_retries
-                        and time.time() < deadline
+                        and self._now() < deadline
                     ):
                         attempts += 1
                         with engine._lock:  # handler threads race this bump
@@ -382,9 +387,10 @@ class _Handler(BaseHTTPRequestHandler):
                         # outage doesn't burn the whole budget back-to-back
                         # (the engine path gets this implicitly via its
                         # requeue latency); capped by the request deadline.
+                        # clockck: allow(bulk retry backoff on a real HTTP worker thread — socket lane only, deadline-capped)
                         time.sleep(
                             min(0.05 * 2**attempts, 1.0,
-                                max(0.0, deadline - time.time()))
+                                max(0.0, deadline - self._now()))
                         )
                         continue
                     return self._send(500, {"error": str(e), "done": int(lo)})
@@ -404,7 +410,7 @@ class _Handler(BaseHTTPRequestHandler):
             for i in np.flatnonzero(~solved & ~unsat)
         ]
         for i, job in pending:
-            if not job.wait(max(1.0, deadline - time.time())):
+            if not job.wait(max(1.0, deadline - self._now())):
                 # All stragglers were submitted up front: cancel every one
                 # still pending, not just the first timed-out job, or the
                 # rest keep burning the engine with no waiter.
@@ -424,7 +430,7 @@ class _Handler(BaseHTTPRequestHandler):
             "unsat": int(unsat.sum()),
             "solved_mask": solved.tolist(),
             "unsat_mask": unsat.tolist(),
-            "duration": time.time() - start,
+            "duration": self._now() - start,
         }
         if as_lines:
             body["solutions"] = [to_line(s) for s in solutions]
@@ -629,11 +635,15 @@ class ApiServer:
         solve_timeout_s: float = 300.0,
         verbose: bool = False,
         access_log: bool = False,
+        clock: Callable[[], float] = time.time,
     ):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.solver_node = solver_node
         self.httpd.solve_timeout_s = solve_timeout_s
         self.httpd.access_log = access_log or verbose
+        # Wall time on purpose (durations are client-visible); injectable
+        # so the handlers stay clockck-clean — see _Handler._now.
+        self.httpd.clock = clock
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -22,7 +22,7 @@ import dataclasses
 import functools
 import threading
 import time
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 
@@ -70,6 +70,7 @@ def race_jobs(
     cancel,
     timeout: Optional[float] = None,
     start: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
 ) -> PortfolioResult:
     """First-verdict-wins over already-submitted racer jobs.
 
@@ -80,14 +81,19 @@ def race_jobs(
 
     Short-interval poll over the racers' events: verdicts arrive at chunk
     granularity (>= ms), so a 10 ms poll adds no meaningful latency and no
-    per-race thread churn.
+    per-race thread churn.  The poll pace is a bounded Event.wait yield
+    (the simnet lane's blessed idiom), never ``time.sleep``; ``clock``
+    (injected; default = real monotonic, bound at import) times the
+    deadline and the result, and ``start`` — when the caller began
+    submitting — must be a reading of the SAME clock.
     """
-    start = time.monotonic() if start is None else start
+    start = clock() if start is None else start
     deadline = None if timeout is None else start + timeout
     winner, winner_index = None, -1
     timed_out = False
+    pacer = threading.Event()  # never set: wait() is a bounded real yield
     while winner is None:
-        if deadline is not None and time.monotonic() >= deadline:
+        if deadline is not None and clock() >= deadline:
             timed_out = True
             break
         for i, job in enumerate(jobs):
@@ -97,7 +103,7 @@ def race_jobs(
         if winner is None:
             if all(j.done.is_set() for j in jobs):
                 break  # every racer resolved without a verdict (budget/overflow)
-            time.sleep(0.01)
+            pacer.wait(0.01)
     for job in jobs:
         if job is not winner and not job.done.is_set():
             cancel(job.uuid)
@@ -105,7 +111,7 @@ def race_jobs(
         winner=winner,
         winner_index=winner_index,
         jobs=jobs,
-        duration_s=time.monotonic() - start,
+        duration_s=clock() - start,
         timed_out=timed_out,
     )
 
@@ -145,6 +151,7 @@ def race_cover(
     dispatch_steps: int = 256,
     native_head_start_s: float = 2.0,
     provisional_grace_s: float = 60.0,
+    clock: Callable[[], float] = time.monotonic,
 ) -> CoverRaceResult:
     """Race exact-cover enumeration: device frontier vs the native C++ DFS.
 
@@ -190,7 +197,7 @@ def race_cover(
     # "still racing" from "every entrant is out" and never blocks forever
     # on a silent double failure.
     results: "queue_mod.Queue[Optional[CoverRaceResult]]" = queue_mod.Queue()
-    start = time.monotonic()
+    start = clock()
     done = threading.Event()  # a WINNING result exists
     native_settled = threading.Event()  # the native entrant is out of the
     #   race, win or decline — releases the device head-start early
@@ -212,7 +219,7 @@ def race_cover(
             results.put(
                 CoverRaceResult(
                     count=count, winner="native", nodes=nodes,
-                    duration_s=time.monotonic() - start, complete=True,
+                    duration_s=clock() - start, complete=True,
                 )
             )
         finally:
@@ -268,7 +275,7 @@ def race_cover(
                     count=int(np.asarray(res.sol_count[0])),
                     winner="device",
                     nodes=int(np.asarray(res.nodes[0])),
-                    duration_s=time.monotonic() - start,
+                    duration_s=clock() - start,
                     complete=complete,
                 )
             )
@@ -286,7 +293,7 @@ def race_cover(
     while pending:
         remaining = (
             None if deadline is None
-            else max(0.0, deadline - time.monotonic())
+            else max(0.0, deadline - clock())
         )
         if remaining is None and provisional is not None:
             # No overall deadline, but a usable lower bound is in hand:
@@ -320,6 +327,7 @@ def race(
     configs: Sequence[SolverConfig] = DEFAULT_PORTFOLIO,
     geom: Optional[Geometry] = None,
     timeout: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
 ) -> PortfolioResult:
     """Race ``configs`` on one board; cancel the losers on the first verdict.
 
@@ -329,7 +337,7 @@ def race(
     """
     if not configs:
         raise ValueError("portfolio needs at least one config")
-    start = time.monotonic()
+    start = clock()
     jobs = []
     try:
         for cfg in configs:
@@ -340,7 +348,7 @@ def race(
         for j in jobs:
             engine.cancel(j.uuid)
         raise
-    res = race_jobs(jobs, cancel=engine.cancel, timeout=timeout, start=start)
+    res = race_jobs(jobs, cancel=engine.cancel, timeout=timeout, start=start, clock=clock)
     if res.winner is not None:
         res.strategy = configs[res.winner_index].branch
     return res

@@ -56,7 +56,6 @@ import functools
 import logging
 import math
 import threading
-import time
 from collections import deque
 from typing import Optional
 
@@ -261,12 +260,12 @@ class ResidentFlight:
         self.rebuilds = 0  # flights torn down and requeued for rebuild
         self.rebuild_requeued = 0  # jobs put back on the admission queue
         self.requeued_static = 0  # jobs rerouted to static flights
-        self.breaker_deflected = 0  # admissions deflected while open
-        self.closed_deflected = 0  # admissions deflected by a closed flight
+        self.breaker_deflected = 0  # lockck: guard(_lock) — admissions deflected while open
+        self.closed_deflected = 0  # lockck: guard(_lock) — admissions deflected by a closed flight
         # Counters (occupancy/queue read under the lock; the rest are
         # single-writer on the device loop, readers tolerate staleness).
-        self.admitted = 0
-        self.rejected = 0
+        self.admitted = 0  # lockck: guard(_lock)
+        self.rejected = 0  # lockck: guard(_lock)
         self.completed = 0
         self.cancelled = 0
         self.expired = 0
@@ -315,7 +314,7 @@ class ResidentFlight:
                 self.rejected += 1
                 return self.SATURATED
             if job.deadline is None:
-                job.deadline = time.monotonic() + self.rcfg.default_deadline_s
+                job.deadline = self.engine._clock() + self.rcfg.default_deadline_s
             self._pending.append(job)
             self.admitted += 1
             return self.ADMITTED
@@ -421,7 +420,7 @@ class ResidentFlight:
         if self.cooling():
             return  # rebuilding after a failure: no device work yet
         self._consume_status()
-        t0 = time.monotonic()
+        t0 = self.engine._clock()
         self._event_wall = 0.0
         self._collect_and_detach()
         self._attach_pending()
@@ -430,7 +429,7 @@ class ResidentFlight:
             # Exclude the detach-round verdict fetch (a sync, recorded by
             # _collect_and_detach) so dispatch_wall stays what it claims:
             # async enqueue time.
-            self.dispatch_wall.record(time.monotonic() - t0 - self._event_wall)
+            self.dispatch_wall.record(self.engine._clock() - t0 - self._event_wall)
 
     def _consume_status(self) -> None:
         """Fetch the previous advance's packed status word (the round's
@@ -439,13 +438,13 @@ class ResidentFlight:
             return
         rec = trace.active()
         tr0 = rec.now() if rec is not None else 0.0
-        t0 = time.monotonic()
+        t0 = self.engine._clock()
         raw = engine_mod.host_fetch(
             self._pending_status, floor_s=self.engine.handicap_s
         )
         self._pending_status = None
         self._status = unpack_status(raw, self.n_slots)
-        sync_s = time.monotonic() - t0
+        sync_s = self.engine._clock() - t0
         self.chunk_wall.record(sync_s)
         # The mergeable twin + the floor estimator (obs/hist.py): resident
         # chunk syncs share the engine-level histograms so cluster-scope
@@ -499,7 +498,7 @@ class ResidentFlight:
         clients (HTTP 504 -> cancel) would keep the bounded queue full of
         dead work — 429-ing live traffic for minutes — and the cancelled
         jobs' done events would stay unset until a slot opened."""
-        now = time.monotonic()
+        now = self.engine._clock()
         with self._lock:
             queued = list(self._pending)
         dead = []
@@ -532,7 +531,7 @@ class ResidentFlight:
             return
         solved = self._status["solved"]
         has_work = self._status["has_work"]
-        now = time.monotonic()
+        now = self.engine._clock()
         detach_mask = np.zeros(self.n_slots, bool)
         leaving: list = []  # (slot, job, cancelled, expired)
         for slot, job in enumerate(self.slots):
@@ -558,13 +557,13 @@ class ResidentFlight:
         ):
             rec = trace.active()
             tr_ev = rec.now() if rec is not None else 0.0
-            t_ev = time.monotonic()
+            t_ev = self.engine._clock()
             nodes, sol_counts, overflowed, solutions = engine_mod.host_fetch(
                 _verdict_jit(self.state),
                 floor_s=self.engine.handicap_s,
                 tag="event",
             )
-            self._event_wall = time.monotonic() - t_ev
+            self._event_wall = self.engine._clock() - t_ev
             self.event_wall.record(self._event_wall)
             self.engine.hist["event_wall_ms"].record(self._event_wall)
             if rec is not None:
@@ -612,7 +611,7 @@ class ResidentFlight:
     def _attach_pending(self) -> None:
         """FIFO-drain the admission queue into free slots, one jit-stable
         attach batch per chunk boundary."""
-        now = time.monotonic()
+        now = self.engine._clock()
         batch: list = []
         while len(batch) < self.rcfg.attach_batch:
             with self._lock:

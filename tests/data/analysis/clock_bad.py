@@ -1,0 +1,15 @@
+"""Fixture: bare clock calls — the direct module call, a from-import
+rename, and a module-level capture (all three laundering shapes)."""
+
+import time as _t
+from time import monotonic as mono
+
+_grab = _t.monotonic  # module-level capture of a banned clock
+
+
+def beat():
+    _t.sleep(0.1)  # bare sleep through an alias
+
+
+def stamp():
+    return mono() + _grab()  # renamed + captured calls

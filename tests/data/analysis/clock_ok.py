@@ -1,0 +1,15 @@
+"""Fixture: clean clock discipline — the injection-seam default
+(reference, not call), calls through the injected clock, and a reasoned
+waiver."""
+
+import time
+from typing import Callable
+
+
+def paced(clock: Callable[[], float] = time.monotonic) -> float:
+    # The default above is a REFERENCE — the seam itself — and passes.
+    return clock()
+
+
+def floor(dt: float) -> None:
+    time.sleep(dt)  # clockck: allow(fixture: a documented simulator sleep)

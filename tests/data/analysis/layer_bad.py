@@ -1,0 +1,15 @@
+"""Fixture: layering violations — a third-party import in a closed layer
+and a FORBIDDEN internal import hidden inside a function body (the lazy
+import idiom layerck must still see)."""
+
+import json  # stdlib: always fine
+
+import some_third_party_lib  # closed layers reject third-party roots
+
+
+def lazy():
+    # Nested-in-function import: must be flagged exactly like a top-level
+    # one (tests pin the line number of this node).
+    from distributed_sudoku_solver_tpu.forbidden_layer import thing
+
+    return thing, json, some_third_party_lib

@@ -1,0 +1,10 @@
+"""Fixture: clean layering — stdlib plus a declared sibling, including
+the nested-lazy form."""
+
+import json
+
+
+def lazy():
+    from distributed_sudoku_solver_tpu.allowed_layer import thing
+
+    return thing, json

@@ -1,0 +1,16 @@
+"""Fixture: a guarded counter written by a helper method that neither
+holds the lock lexically nor carries the ``_locked`` suffix contract."""
+
+import threading
+
+
+class Counted:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # lockck: guard(_lock)
+
+    def bump(self):
+        # A caller may well hold the lock here — but nothing says so, and
+        # that undocumented assumption is exactly the bug family lockck
+        # exists to kill.  Flagged.
+        self.hits += 1

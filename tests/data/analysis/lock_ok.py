@@ -1,0 +1,20 @@
+"""Fixture: the three blessed write shapes for a guarded attribute —
+lexical with-block, the ``_locked`` helper contract, and dict mutation
+through a subscript under the lock."""
+
+import threading
+
+
+class Counted:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # lockck: guard(_lock)
+        self.per_kind = {}  # lockck: guard(_lock)
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+            self.per_kind["k"] = self.per_kind.get("k", 0) + 1
+
+    def _bump_locked(self):
+        self.hits += 1  # caller holds the lock: the suffix says so

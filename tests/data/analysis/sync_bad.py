@@ -1,0 +1,11 @@
+"""Fixture: hot-loop sync violations — an un-proven np.asarray and the
+int()-over-device-value heuristic."""
+
+import numpy as np
+
+
+class Hot:
+    def step(self, state):
+        grabbed = np.asarray(state.solution)  # device value: flagged
+        n = int(state.status[0])  # hot-loop scalar fetch: flagged
+        return grabbed, n

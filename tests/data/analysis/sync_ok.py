@@ -1,0 +1,22 @@
+"""Fixture: clean hot loop — values proven host-side through the seam
+(tuple unpack + self-attr + derived locals) and one reasoned waiver."""
+
+import numpy as np
+
+
+def host_fetch(x):
+    return x  # the seam: its body is exempt by name
+
+
+class Hot:
+    def prime(self):
+        self._status = host_fetch(self.pending)
+
+    def step(self, state):
+        info, extra = host_fetch((state.status, state.extra))
+        n = int(info[0])  # host-proven via the tuple unpack
+        solved = self._status["solved"]  # host-proven class-wide attr
+        m = int(solved[0])  # host-proven via derivation
+        pinned = np.asarray(extra[1], np.int32)  # host-proven operand
+        cold = np.asarray([1, 2])  # syncck: allow(fixture: literal host data)
+        return n, m, pinned, cold

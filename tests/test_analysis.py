@@ -1,0 +1,370 @@
+"""Static-analysis gate (distributed_sudoku_solver_tpu/analysis): the
+tier-1 wiring that turns an invariant regression into a test failure
+instead of a review-round catch.
+
+Lanes:
+* fixture lane — one violating and one clean synthetic module per rule
+  (tests/data/analysis), driven through the checkers with injected
+  configs, pinning that each rule actually FIRES (a linter that never
+  fires passes any tree);
+* the gate — `python -m distributed_sudoku_solver_tpu.analysis` over the
+  real package tree exits 0 (all findings fixed or reason-waived), never
+  imports jax, and finishes inside the acceptance budget;
+* determinism — two runs produce byte-identical --json reports;
+* contract cross-pins — the *ck-family exit codes are one scheme
+  (obs/exitcodes.py) and the simnet runtime guard's banned list covers
+  clockck's sleep/monotonic half (one list, two lanes).
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from distributed_sudoku_solver_tpu.analysis import clockck, layerck, lockck, syncck
+from distributed_sudoku_solver_tpu.analysis import manifest
+from distributed_sudoku_solver_tpu.analysis.__main__ import main, run
+from distributed_sudoku_solver_tpu.analysis.common import SourceModule
+from distributed_sudoku_solver_tpu.obs import exitcodes, promck, traceck
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "data" / "analysis"
+
+
+def load(name: str, modname=None) -> SourceModule:
+    path = FIXTURES / name
+    return SourceModule(path, name, modname)
+
+
+# -- layerck fixtures ----------------------------------------------------------
+
+def test_layerck_fires_on_nested_import_and_third_party():
+    mod = load("layer_bad.py", modname="layer_bad")
+    layers = {
+        "layer_bad": {
+            "closed": True,
+            "allow": ("allowed_layer",),
+            "third_party": (),
+        }
+    }
+    findings = layerck.check_module(mod, layers)
+    msgs = {(f.line, f.waived) for f in findings}
+    assert len(findings) == 2, findings
+    # The nested-in-function import is seen and attributed to its line.
+    nested_line = next(
+        i + 1
+        for i, ln in enumerate(mod.text.splitlines())
+        if "forbidden_layer" in ln
+    )
+    assert (nested_line, False) in msgs
+    # Open-layer form catches the same nested import via forbid.
+    open_layers = {"layer_bad": {"closed": False, "forbid": ("forbidden_layer",)}}
+    open_findings = layerck.check_module(mod, open_layers)
+    assert [f.line for f in open_findings] == [nested_line]
+
+
+def test_layerck_clean_fixture():
+    mod = load("layer_ok.py", modname="layer_ok")
+    layers = {
+        "layer_ok": {"closed": True, "allow": ("allowed_layer",), "third_party": ()}
+    }
+    assert layerck.check_module(mod, layers) == []
+
+
+def test_layerck_declared_exception_carves_out():
+    # The real tree's one declared up-import: ops -> serving.faults.
+    mod = load("layer_bad.py", modname="layer_bad")
+    layers = {
+        "layer_bad": {
+            "closed": False,
+            "forbid": ("forbidden_layer",),
+            "except": ("forbidden_layer.thing",),
+        }
+    }
+    assert layerck.check_module(mod, layers) == []
+
+
+# -- clockck fixtures ----------------------------------------------------------
+
+def _clock_findings(mod):
+    return clockck.check_module(
+        mod,
+        manifest.CLOCK_SCOPED_DIRS,
+        manifest.CLOCK_BANNED_CALLS,
+        manifest.CLOCK_SEAMS,
+        scope_all=True,
+    )
+
+
+def test_clockck_fires_on_alias_rename_and_capture():
+    findings = _clock_findings(load("clock_bad.py"))
+    live = [f for f in findings if not f.waived]
+    # _t.sleep, mono(), _grab() — the three laundering shapes.
+    assert len(live) == 3, findings
+    dotted = " ".join(f.message for f in live)
+    assert "time.sleep" in dotted and "time.monotonic" in dotted
+
+
+def test_clockck_clean_fixture_reference_default_and_waiver():
+    findings = _clock_findings(load("clock_ok.py"))
+    assert [f for f in findings if not f.waived] == []
+    waived = [f for f in findings if f.waived]
+    assert len(waived) == 1 and waived[0].reason  # the reasoned sleep
+
+
+# -- syncck fixtures -----------------------------------------------------------
+
+def _sync_findings(name):
+    return syncck.check_module(
+        load(name),
+        scoped_files=(name,),
+        hot_regions={name: ("Hot.step",)},
+        seam_funcs=manifest.SYNC_SEAM_FUNCS,
+        host_sources=manifest.SYNC_HOST_SOURCES,
+        numpy_calls=manifest.SYNC_NUMPY_CALLS,
+        method_calls=manifest.SYNC_METHOD_CALLS,
+        jax_calls=manifest.SYNC_JAX_CALLS,
+    )
+
+
+def test_syncck_fires_on_unproven_asarray_and_hot_int():
+    findings = _sync_findings("sync_bad.py")
+    live = [f for f in findings if not f.waived]
+    assert len(live) == 2, findings
+    kinds = " ".join(f.message for f in live)
+    assert "np.asarray" in kinds and "int()" in kinds
+
+
+def test_syncck_host_proof_and_waiver():
+    findings = _sync_findings("sync_ok.py")
+    assert [f for f in findings if not f.waived] == []
+    waived = [f for f in findings if f.waived]
+    assert len(waived) == 1 and waived[0].reason  # the literal-data waiver
+
+
+# -- lockck fixtures -----------------------------------------------------------
+
+def test_lockck_fires_on_unlocked_helper_write():
+    findings = lockck.check_modules([load("lock_bad.py")])
+    assert len(findings) == 1 and not findings[0].waived
+    assert "hits" in findings[0].message
+
+
+def test_lockck_clean_fixture_with_block_suffix_and_subscript():
+    assert lockck.check_modules([load("lock_ok.py")]) == []
+
+
+def test_lockck_cross_module_write_checks_base_lock(tmp_path):
+    # The http.py shape: another module bumps engine.fault_bulk_retries —
+    # OK under `with engine._lock:`, flagged bare.
+    decl = tmp_path / "decl.py"
+    decl.write_text(
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self.jobs = 0  # lockck: guard(_lock)\n"
+    )
+    writer = tmp_path / "writer.py"
+    writer.write_text(
+        "def good(engine):\n"
+        "    with engine._lock:\n"
+        "        engine.jobs += 1\n"
+        "def bad(engine):\n"
+        "    engine.jobs += 1\n"
+    )
+    mods = [
+        SourceModule(decl, "decl.py", None),
+        SourceModule(writer, "writer.py", None),
+    ]
+    findings = lockck.check_modules(mods)
+    assert [(f.path, f.line) for f in findings] == [("writer.py", 5)]
+
+
+def test_clockck_catches_two_level_datetime_and_ns_family(tmp_path):
+    # Review-round finding: `import datetime; datetime.datetime.now()`
+    # and the perf_counter/*_ns spellings used to launder straight
+    # through.
+    p = tmp_path / "w.py"
+    p.write_text(
+        "import datetime\nimport time\n\n\n"
+        "def f():\n"
+        "    a = datetime.datetime.now()\n"
+        "    b = time.perf_counter()\n"
+        "    c = time.monotonic_ns()\n"
+        "    return a, b, c\n"
+    )
+    findings = clockck.check_module(
+        SourceModule(p, "w.py", None),
+        manifest.CLOCK_SCOPED_DIRS,
+        manifest.CLOCK_BANNED_CALLS,
+        {},
+        scope_all=True,
+    )
+    dotted = " ".join(f.message for f in findings)
+    assert len(findings) == 3, findings
+    assert "datetime.now" in dotted
+    assert "time.perf_counter" in dotted and "time.monotonic_ns" in dotted
+
+
+def test_lockck_self_writes_scope_to_the_declaring_class(tmp_path):
+    # Review-round finding: the registry used to key on the bare attr
+    # name, so an unrelated class's own (unguarded) `admitted` was
+    # falsely constrained by another class's declaration.
+    p = tmp_path / "two.py"
+    p.write_text(
+        "import threading\n\n\n"
+        "class Guarded:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.admitted = 0  # lockck: guard(_lock)\n\n"
+        "    def bad(self):\n"
+        "        self.admitted += 1\n\n\n"
+        "class Unrelated:\n"
+        "    def __init__(self):\n"
+        "        self.admitted = 0\n\n"
+        "    def fine(self):\n"
+        "        self.admitted += 1\n"
+    )
+    findings = lockck.check_modules([SourceModule(p, "two.py", None)])
+    assert [f.line for f in findings] == [10], findings
+
+
+def test_runner_refuses_empty_scan_root(tmp_path, capsys):
+    # Review-round finding: a typo'd --root used to report "0 violations
+    # over 0 files" and exit 0 — a gate that checks nothing must fail
+    # as a tool error, not pass.
+    assert main(["--root", str(tmp_path / "nope")]) == exitcodes.EXIT_INTERNAL
+    capsys.readouterr()
+
+
+# -- waiver grammar ------------------------------------------------------------
+
+def test_waiver_without_reason_stays_a_violation(tmp_path):
+    p = tmp_path / "w.py"
+    p.write_text(
+        "import time as _t\n\n\ndef f():\n    _t.sleep(1)  # clockck: allow()\n"
+    )
+    findings = clockck.check_module(
+        SourceModule(p, "w.py", None),
+        manifest.CLOCK_SCOPED_DIRS,
+        manifest.CLOCK_BANNED_CALLS,
+        {},
+        scope_all=True,
+    )
+    assert len(findings) == 1 and not findings[0].waived
+    assert "no reason" in findings[0].message
+
+
+def test_def_level_waiver_covers_the_function(tmp_path):
+    p = tmp_path / "w.py"
+    p.write_text(
+        "import time as _t\n\n\n"
+        "def f():  # clockck: allow(whole function is a declared simulator)\n"
+        "    _t.sleep(1)\n    _t.sleep(2)\n"
+    )
+    findings = clockck.check_module(
+        SourceModule(p, "w.py", None),
+        manifest.CLOCK_SCOPED_DIRS,
+        manifest.CLOCK_BANNED_CALLS,
+        {},
+        scope_all=True,
+    )
+    assert len(findings) == 2 and all(f.waived for f in findings)
+
+
+# -- the tier-1 gate -----------------------------------------------------------
+
+def test_runner_clean_and_jax_free_over_package():
+    """The acceptance pin: all four rules over the real tree, exit 0, no
+    jax in the process, inside the <5 s budget (measured ~1 s; the budget
+    includes interpreter start on a loaded 2-core container)."""
+    code = (
+        "import sys\n"
+        "from distributed_sudoku_solver_tpu.analysis.__main__ import main\n"
+        "rc = main(['--json'])\n"
+        "assert 'jax' not in sys.modules, 'analysis runner imported jax'\n"
+        "sys.exit(rc)\n"
+    )
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == exitcodes.EXIT_CLEAN, (
+        proc.stdout[-4000:],
+        proc.stderr[-4000:],
+    )
+    report = json.loads(proc.stdout)
+    assert set(report["rules"]) == {"layerck", "clockck", "syncck", "lockck"}
+    assert all(
+        r["violations"] == [] for r in report["rules"].values()
+    ), report
+    # Every committed waiver carries a reason (the "ships clean or
+    # reason-waived" acceptance).
+    for r in report["rules"].values():
+        for w in r["waived"]:
+            assert w["reason"].strip()
+    assert elapsed < 5.0, f"analysis run took {elapsed:.1f}s"
+
+
+def test_runner_json_is_deterministic():
+    r1, _ = run()
+    r2, _ = run()
+    a = json.dumps(r1, indent=2, sort_keys=True)
+    b = json.dumps(r2, indent=2, sort_keys=True)
+    assert a == b
+
+
+def test_runner_exit_codes_per_rule_over_fixtures(capsys):
+    # The fixture dir seeds exactly one real-manifest violation
+    # (lock_bad.py): whole run exits 1, --rule lockck exits 1, while
+    # --rule layerck alone exits 0 — the per-rule exit-code contract.
+    root = str(FIXTURES)
+    assert main(["--root", root]) == exitcodes.EXIT_VIOLATIONS
+    assert main(["--root", root, "--rule", "lockck"]) == exitcodes.EXIT_VIOLATIONS
+    assert main(["--root", root, "--rule", "layerck"]) == exitcodes.EXIT_CLEAN
+    capsys.readouterr()
+
+
+def test_benchmarks_scope_is_report_only(capsys):
+    # Benchmark scripts are wall-clock tools: findings are reported, the
+    # exit stays 0 (pyproject/README document the lane as report-only).
+    assert main(["--scope", "benchmarks"]) == exitcodes.EXIT_CLEAN
+    out = capsys.readouterr()
+    assert "scope=benchmarks" in out.out
+
+
+def test_usage_error_exits_internal(capsys):
+    assert main(["--rule", "nosuchrule"]) == exitcodes.EXIT_INTERNAL
+    capsys.readouterr()
+
+
+# -- contract cross-pins -------------------------------------------------------
+
+def test_ck_family_shares_one_exit_code_scheme():
+    assert (traceck.EXIT_CLEAN, traceck.EXIT_VIOLATIONS, traceck.EXIT_INTERNAL) == (
+        exitcodes.EXIT_CLEAN,
+        exitcodes.EXIT_VIOLATIONS,
+        exitcodes.EXIT_INTERNAL,
+    )
+    assert (promck.EXIT_CLEAN, promck.EXIT_VIOLATIONS, promck.EXIT_INTERNAL) == (
+        exitcodes.EXIT_CLEAN,
+        exitcodes.EXIT_VIOLATIONS,
+        exitcodes.EXIT_INTERNAL,
+    )
+    assert (exitcodes.EXIT_CLEAN, exitcodes.EXIT_VIOLATIONS, exitcodes.EXIT_INTERNAL) == (0, 1, 2)
+
+
+def test_runtime_guard_list_covers_clockck_sleep_half():
+    """One list, two lanes: every runtime-bannable clock in
+    CLOCK_BANNED_CALLS is in the simnet guard's list.  time.time is the
+    documented exception (logging.LogRecord reads it at runtime) and
+    datetime construction never paces anything."""
+    runtime = set(manifest.SIMNET_RUNTIME_BANNED)
+    assert ("time", "sleep") in runtime
+    assert ("time", "monotonic") in runtime
+    assert {("socket", "socket"), ("select", "select")} <= runtime

@@ -522,6 +522,9 @@ def test_promck_cli_roundtrip(tmp_path):
     assert promck.main([str(bad)]) == 1
     assert promck.main([]) == 2
     assert promck.check_file(str(tmp_path / "missing.txt")) != []
+    # The *ck-family exit-code contract (obs/exitcodes.py): an unreadable
+    # input is the tool failing (2), not the exposition failing (1).
+    assert promck.main([str(tmp_path / "missing.txt")]) == 2
 
 
 # -- bench regression gate -----------------------------------------------------

@@ -154,6 +154,10 @@ def test_traceck_cli_roundtrip(tmp_path):
     bad.write_text("[1, 2]")
     assert traceck.main([str(bad)]) == 1
     assert traceck.check_file(str(tmp_path / "missing.json")) != []
+    # The *ck-family exit-code contract (obs/exitcodes.py): findings = 1,
+    # but an input the tool cannot READ is the tool failing = 2.
+    assert traceck.main([str(tmp_path / "missing.json")]) == 2
+    assert traceck.main([]) == 2
 
 
 def test_flight_recorder_dump_file(tmp_path):
