@@ -2,10 +2,15 @@
 
 ``obs/traceck.py`` and ``obs/promck.py`` lint the system's *output*
 (trace JSON, Prometheus exposition); this package is the same discipline
-aimed at the *source*.  Four AST-based rules behind one runner::
+aimed at the *source* — and, since round 14, at what XLA *compiles from*
+it.  Four AST-based fast rules plus one opt-in compiled-layer rule
+behind one runner::
 
     python -m distributed_sudoku_solver_tpu.analysis [--json] [--rule R]
                                                      [--scope benchmarks]
+                                                     [--strict-waivers]
+    python -m distributed_sudoku_solver_tpu.analysis --rule jaxck \
+                                                     [--update-golden]
 
 * **layerck** — the import-layering manifest (``manifest.LAYERS``):
   ``obs/``, ``serving/faults.py``, ``cluster/wire.py``,
@@ -25,20 +30,34 @@ aimed at the *source*.  Four AST-based rules behind one runner::
   (a small dataflow pass over ``host_fetch``/``unpack_status`` results).
 * **lockck** — attributes declared ``# lockck: guard(_lock)`` are only
   written under ``with <base>._lock:`` (or in ``*_locked`` helpers).
+* **jaxck** (opt-in: the ONE rule that imports jax, lazily) — abstractly
+  traces every ``manifest.ENTRY_POINTS`` jit program at canonical tiny
+  shapes and proves the compiled layer: donation lowers to real
+  ``input_output_aliases``, serving-hot jaxprs are callback-free, dtypes
+  stay f64-free and scalar params pinned, and a canonicalized jaxpr
+  fingerprint per program matches ``analysis/goldens/jaxck.json`` so
+  HLO drift (= XLA cache invalidation) is visible and blessed with
+  ``--update-golden``, never a mystery tier-1 slowdown.
 
 Waiver grammar (all rules): a trailing ``# <rule>: allow(<reason>)`` on
 the flagged line, or on the enclosing ``def`` line to waive a whole
 function.  The reason string is mandatory; waived findings are reported
-(and carried in ``--json``) but do not fail the run.
+(and carried in ``--json``) but do not fail the run.  Waivers are
+themselves checked: one whose rule ran but no longer fires on its line
+is reported stale (``--strict-waivers`` makes that exit 1).
 
 Exit codes are the *ck-family contract* (``obs/exitcodes.py``): 0 clean,
-1 violations, 2 internal/usage error.  Stdlib-``ast`` only — the runner
-never imports jax, and tier-1 (``tests/test_analysis.py``) pins both
-that and a clean exit over the package tree.
+1 violations, 2 internal/usage error.  The default lane is
+stdlib-``ast`` only — it never imports jax, and tier-1
+(``tests/test_analysis.py``) pins both that and a clean exit over the
+package tree; the jaxck lane's clean exit and golden determinism are
+pinned by ``tests/test_jaxck.py``.
 """
 
 from distributed_sudoku_solver_tpu.analysis.common import (  # noqa: F401
+    ALL_RULES,
     Finding,
+    LAZY_RULES,
     RULES,
 )
 from distributed_sudoku_solver_tpu.obs.exitcodes import (  # noqa: F401

@@ -7,9 +7,25 @@ narrows to one or more rules, and the exit code then reflects exactly
 the selected rules — the "per-rule exit codes" contract: a CI step can
 gate on one rule while another is still being burned down.
 
+Two lanes behind one flag:
+
+* the default fast lane (``RULES``) is stdlib-``ast`` only — no jax
+  import, <5 s, byte-identical output run to run (both pinned by
+  tests/test_analysis.py);
+* ``--rule jaxck`` is the compiled-layer lane: it lazily imports jax,
+  abstractly traces every ``manifest.ENTRY_POINTS`` program and proves
+  donation/callback/dtype/HLO-golden invariants (``analysis/jaxck.py``).
+  ``--update-golden`` blesses HLO drift by rewriting
+  ``analysis/goldens/jaxck.json``.
+
+Waiver hygiene rides every run: a ``# <rule>: allow(...)`` comment whose
+rule (among the rules that ran) no longer fires on that line is reported
+as *stale* — report-only by default, exit 1 under ``--strict-waivers``.
+
 Deterministic by construction: sorted file walk, sorted findings,
 ``sort_keys`` JSON — two runs over the same tree are byte-identical
-(pinned by tests/test_analysis.py).
+(pinned by tests/test_analysis.py for the fast lane and
+tests/test_jaxck.py for the jaxck lane).
 """
 
 from __future__ import annotations
@@ -24,9 +40,11 @@ from typing import List, Optional, Tuple
 from distributed_sudoku_solver_tpu.analysis import clockck, layerck, lockck, syncck
 from distributed_sudoku_solver_tpu.analysis import manifest
 from distributed_sudoku_solver_tpu.analysis.common import (
+    ALL_RULES,
     RULES,
     Finding,
     iter_sources,
+    stale_waivers,
 )
 from distributed_sudoku_solver_tpu.obs.exitcodes import (
     EXIT_CLEAN,
@@ -41,6 +59,7 @@ def run(
     root: Optional[Path] = None,
     scope: str = "package",
     rules: Tuple[str, ...] = RULES,
+    update_golden: bool = False,
 ) -> Tuple[dict, List[Finding]]:
     """Run the selected rules; returns (json-ready report, findings)."""
     if scope == "benchmarks":
@@ -79,6 +98,17 @@ def run(
             ))
     if "lockck" in rules:
         findings.extend(lockck.check_modules(mods))
+    jaxck_summary = None
+    if "jaxck" in rules:
+        # The lazy lane: this import chain touches jax only inside
+        # jaxck's functions, and only here — the default rules tuple
+        # never includes jaxck, so the fast lane stays jax-free.
+        from distributed_sudoku_solver_tpu.analysis import jaxck
+
+        jx_findings, jaxck_summary = jaxck.check_entry_points(
+            mods=mods, update_golden=update_golden
+        )
+        findings.extend(jx_findings)
     findings.sort()
     report = {
         "scope": scope,
@@ -96,24 +126,49 @@ def run(
             for rule in sorted(rules)
         },
         "files_scanned": len(mods),
+        # Waiver hygiene: sites whose rule ran and no longer fires there.
+        "stale_waivers": [
+            {"path": path, "line": line, "rule": rule, "reason": reason}
+            for path, line, rule, reason in stale_waivers(mods, rules)
+        ],
     }
+    if jaxck_summary is not None:
+        report["jaxck"] = {
+            "drifted": jaxck_summary["drifted"],
+            "golden_written": jaxck_summary["golden_written"],
+            "programs": len(jaxck_summary["programs"]),
+        }
     return report, findings
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m distributed_sudoku_solver_tpu.analysis",
-        description="AST-based invariant linter (layerck/clockck/syncck/lockck)",
+        description=(
+            "invariant linter: layerck/clockck/syncck/lockck (fast, no "
+            "jax) + the opt-in compiled-layer lane (--rule jaxck)"
+        ),
     )
     parser.add_argument("--json", action="store_true", help="machine report")
     parser.add_argument(
-        "--rule", action="append", choices=RULES,
-        help="run only this rule (repeatable); exit code reflects it alone",
+        "--rule", action="append", choices=ALL_RULES,
+        help="run only this rule (repeatable); exit code reflects it alone. "
+        "jaxck is opt-in: it imports jax (the default lane never does)",
     )
     parser.add_argument(
         "--scope", choices=("package", "benchmarks"), default="package",
         help="'benchmarks' scans benchmarks/ report-only (always exits 0 "
         "unless the tool itself fails)",
+    )
+    parser.add_argument(
+        "--update-golden", action="store_true",
+        help="(jaxck) bless HLO drift: rewrite analysis/goldens/jaxck.json "
+        "from the current tree — commit the diff with the PR that causes it",
+    )
+    parser.add_argument(
+        "--strict-waivers", action="store_true",
+        help="exit 1 when a committed waiver's rule no longer fires on its "
+        "line (default: stale waivers are report-only)",
     )
     parser.add_argument(
         "--root", type=Path, default=None, help=argparse.SUPPRESS
@@ -125,8 +180,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         # but normalise --help's 0.
         return EXIT_INTERNAL if e.code else EXIT_CLEAN
     rules = tuple(args.rule) if args.rule else RULES
+    if args.update_golden and "jaxck" not in rules:
+        print(
+            "analysis: --update-golden only applies to --rule jaxck",
+            file=sys.stderr,
+        )
+        return EXIT_INTERNAL
     try:
-        report, findings = run(root=args.root, scope=args.scope, rules=rules)
+        report, findings = run(
+            root=args.root, scope=args.scope, rules=rules,
+            update_golden=args.update_golden,
+        )
     except Exception:  # noqa: BLE001 - the tool failing is exit 2, loudly
         traceback.print_exc()
         return EXIT_INTERNAL
@@ -141,22 +205,49 @@ def main(argv: Optional[List[str]] = None) -> int:
         return EXIT_INTERNAL
     violations = [f for f in findings if not f.waived]
     waived = [f for f in findings if f.waived]
+    stale = report["stale_waivers"]
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
         for f in findings:
             print(f.render(), file=sys.stderr if not f.waived else sys.stdout)
+        for s in stale:
+            print(
+                f"stale-waiver: {s['path']}:{s['line']}: "
+                f"# {s['rule']}: allow({s['reason']}) — {s['rule']} no "
+                "longer fires here; delete the waiver"
+            )
         for rule in sorted(rules):
             nv = sum(1 for f in violations if f.rule == rule)
             nw = sum(1 for f in waived if f.rule == rule)
             print(f"analysis: {rule}: {nv} violation(s), {nw} waived")
+        if "jaxck" in report:
+            jx = report["jaxck"]
+            if jx["golden_written"]:
+                print(
+                    f"analysis: jaxck: goldens updated for {jx['programs']} "
+                    f"program(s) ({len(jx['drifted'])} drifted) — commit "
+                    "analysis/goldens/jaxck.json"
+                )
+            elif jx["drifted"]:
+                print(
+                    f"analysis: jaxck: HLO drift in {len(jx['drifted'])} "
+                    "program(s) — this PR invalidates the XLA cache for: "
+                    + ", ".join(jx["drifted"])
+                )
+        if stale:
+            print(f"analysis: {len(stale)} stale waiver(s)")
         print(
             f"analysis: {len(violations)} violation(s) over "
             f"{report['files_scanned']} files [scope={args.scope}]"
         )
     if args.scope == "benchmarks":
         return EXIT_CLEAN  # report-only lane (see --scope help)
-    return EXIT_VIOLATIONS if violations else EXIT_CLEAN
+    if violations:
+        return EXIT_VIOLATIONS
+    if stale and args.strict_waivers:
+        return EXIT_VIOLATIONS
+    return EXIT_CLEAN
 
 
 if __name__ == "__main__":

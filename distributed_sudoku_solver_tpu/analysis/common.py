@@ -17,7 +17,15 @@ import tokenize
 from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Tuple
 
+#: The default fast lane: stdlib-``ast`` only, no jax, <5 s.
 RULES = ("layerck", "clockck", "syncck", "lockck")
+
+#: Rules that lazily import heavy dependencies and therefore only run
+#: when explicitly selected (``--rule jaxck``): the default lane's
+#: no-jax/<5 s contract stays intact (pinned by tests/test_analysis.py).
+LAZY_RULES = ("jaxck",)
+
+ALL_RULES = RULES + LAZY_RULES
 
 #: The waiver grammar (README "Static analysis"): a trailing comment
 #: ``# <rule>: allow(<reason>)`` on the flagged line — or on the
@@ -25,7 +33,7 @@ RULES = ("layerck", "clockck", "syncck", "lockck")
 #: The reason is REQUIRED: an empty ``allow()`` is itself a violation, so
 #: every committed waiver carries its why.
 WAIVER_RE = re.compile(
-    r"#\s*(layerck|clockck|syncck|lockck):\s*allow\(([^)]*)\)"
+    r"#\s*(layerck|clockck|syncck|lockck|jaxck):\s*allow\(([^)]*)\)"
 )
 
 #: lockck's declaration grammar: ``# lockck: guard(<lock_attr>)`` on the
@@ -69,6 +77,11 @@ class SourceModule:
         self.modname = modname  # package-relative dotted name, or None
         self.text = abspath.read_text(encoding="utf-8")
         self.tree = ast.parse(self.text, filename=str(abspath))
+        #: (rule, comment line) waiver sites a checker actually consulted
+        #: — the complement (see :func:`stale_waivers`) is a waiver whose
+        #: rule no longer fires there, itself worth reporting before the
+        #: committed waiver set rots.
+        self.used_waiver_sites: set = set()
         self.comments: Dict[int, str] = {}
         try:
             for tok in tokenize.generate_tokens(
@@ -98,8 +111,17 @@ class SourceModule:
                 continue
             for m in WAIVER_RE.finditer(comment):
                 if m.group(1) == rule:
+                    self.used_waiver_sites.add((rule, at))
                     return m.group(2).strip()
         return None
+
+    def waiver_sites(self) -> List[Tuple[str, int, str]]:
+        """Every waiver comment in the file: (rule, line, reason)."""
+        out = []
+        for line in sorted(self.comments):
+            for m in WAIVER_RE.finditer(self.comments[line]):
+                out.append((m.group(1), line, m.group(2).strip()))
+        return out
 
 
 def finding(
@@ -156,6 +178,26 @@ class QualnameVisitor(ast.NodeVisitor):
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
         self._visit_func(node)
+
+
+def stale_waivers(
+    mods: List["SourceModule"], rules: Tuple[str, ...]
+) -> List[Tuple[str, int, str, str]]:
+    """Waiver comments whose rule (among the rules that RAN) no longer
+    fires on that line: (path, line, rule, reason), sorted.
+
+    Must be called after the checkers, which populate
+    ``used_waiver_sites`` as they resolve findings.  Scoped to the
+    selected rules — a jaxck waiver is not stale just because the fast
+    lane didn't run jaxck."""
+    out = []
+    for mod in mods:
+        for rule, line, reason in mod.waiver_sites():
+            if rule not in rules:
+                continue
+            if (rule, line) not in mod.used_waiver_sites:
+                out.append((mod.rel, line, rule, reason))
+    return sorted(out)
 
 
 def expr_root(node: ast.AST) -> Optional[str]:

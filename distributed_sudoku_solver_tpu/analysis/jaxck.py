@@ -1,0 +1,633 @@
+"""jaxck: prove the compiled layer the source rules cannot see.
+
+layerck/clockck/syncck/lockck prove source-level contracts; the
+contracts that actually price the serving path live one layer down, in
+what XLA compiles.  ``donate_argnums`` silently no-ops when its aliasing
+precondition fails (the round-8 zero-copy win evaporates without a
+traceback); a stray ``pure_callback``/``debug.print`` reintroduces the
+hidden per-dispatch host syncs syncck hunts, but at run time inside the
+compiled program where no AST rule can reach; and any change to
+shared-op HLO invalidates ``.cache/xla`` for every containing program —
+ROADMAP prices the next cold run at ~1170 s.
+
+jaxck abstractly traces every ``manifest.ENTRY_POINTS`` program at
+canonical tiny shapes (``jax.jit(...).trace`` + ``.lower()`` — no
+execution, no device, works on a CPU-only container) and proves:
+
+* **donation lowers** — every donated pytree leaf of a ``threads``
+  program produces a real ``input_output_aliases`` entry in the lowered
+  StableHLO (``tf.aliasing_output``); ``drains`` programs (terminal
+  frees) record their alias count in the golden instead.
+* **callback-free hot programs** — no ``pure_callback`` /
+  ``io_callback`` / ``debug_callback`` primitive anywhere in a
+  serving-hot jaxpr, sub-jaxprs included.
+* **dtype discipline** — no f64/c128 aval anywhere in any traced
+  program, no weak-typed entry avals, and (statically, via the package
+  AST) no call site handing a bare Python numeric literal to a traced
+  parameter of an entry point — a weak-type cache fork that silently
+  doubles retraces.
+* **HLO-drift goldens** — a canonicalized jaxpr fingerprint per entry
+  point, committed to ``analysis/goldens/jaxck.json``; drift is
+  reported as "this PR invalidates the XLA cache for N programs" and
+  blessed explicitly with ``--update-golden``.
+
+This is the one analysis module allowed to import jax (see
+``manifest.LAYERS`` — the import is lazy, inside functions, so the
+default no-jax fast lane stays byte-identical and <5 s).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributed_sudoku_solver_tpu.analysis import manifest
+from distributed_sudoku_solver_tpu.analysis.common import (
+    Finding,
+    QualnameVisitor,
+    SourceModule,
+    call_name,
+    finding,
+)
+
+_PACKAGE = "distributed_sudoku_solver_tpu"
+GOLDEN_PATH = Path(__file__).resolve().parent / "goldens" / "jaxck.json"
+
+#: Hex addresses (bound methods, partials, callback ids) are the one
+#: run-varying thing a jaxpr pretty-print can contain — canonicalize
+#: them away so fingerprints are stable across processes and hosts.
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def canonicalize(jaxpr_text: str) -> str:
+    return _ADDR_RE.sub("0xCANON", jaxpr_text)
+
+
+def fingerprint(jaxpr_text: str) -> str:
+    return hashlib.sha256(canonicalize(jaxpr_text).encode()).hexdigest()
+
+
+# -- canonical-shape resolution (the spec mini-language) -----------------------
+
+
+class _Canon:
+    """Resolved canonical objects for one ``JAXCK_CANON`` dict.
+
+    Everything jax-flavored is built here, once, lazily — entry checks
+    share the abstract Frontier specs (eval_shape never executes)."""
+
+    def __init__(self, canon: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_sudoku_solver_tpu.models.geometry import Geometry
+        from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+
+        self._jax = jax
+        self.dims = dict(canon["dims"])
+        self.geom = Geometry(*canon["geom"])
+        self.configs = {
+            name: SolverConfig(**kw) for name, kw in canon["configs"].items()
+        }
+        self.dtypes = {
+            "uint8": jnp.uint8,
+            "uint32": jnp.uint32,
+            "int32": jnp.int32,
+            "float32": jnp.float32,
+            "bool": jnp.bool_,
+        }
+        self._frontiers: Dict[str, object] = {}
+        self._resident = None
+        self._mesh = None
+        self._problem = None
+
+    def _dim(self, d):
+        return self.dims[d] if isinstance(d, str) else int(d)
+
+    def frontier(self, config_name: str):
+        """Abstract Frontier at L lanes / J jobs of the named config."""
+        if config_name not in self._frontiers:
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+
+            from distributed_sudoku_solver_tpu.ops.frontier import (
+                init_frontier_roots,
+            )
+
+            L, J, n = self.dims["L"], self.dims["J"], self.dims["n"]
+            self._frontiers[config_name] = jax.eval_shape(
+                functools.partial(
+                    init_frontier_roots,
+                    n_jobs=J,
+                    config=self.configs[config_name],
+                ),
+                jax.ShapeDtypeStruct((L, n, n), jnp.uint32),
+                jax.ShapeDtypeStruct((L,), jnp.int32),
+            )
+        return self._frontiers[config_name]
+
+    def resident(self):
+        """The scheduler's gang frontier (slots gangs of G lanes)."""
+        if self._resident is None:
+            import functools
+
+            import jax
+
+            from distributed_sudoku_solver_tpu.serving.scheduler import (
+                _init_resident,
+            )
+
+            self._resident = jax.eval_shape(
+                functools.partial(
+                    _init_resident,
+                    geom=self.geom,
+                    config=self.configs["config_gang"],
+                    n_slots=self.dims["slots"],
+                )
+            )
+        return self._resident
+
+    def mesh(self):
+        # Pinned to exactly ONE device regardless of host topology, so
+        # goldens derived on a TPU pod and a CPU laptop agree.
+        if self._mesh is None:
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            self._mesh = Mesh(np.array(jax.devices()[:1]), ("lanes",))
+        return self._mesh
+
+    def problem(self):
+        if self._problem is None:
+            from distributed_sudoku_solver_tpu.ops.solve import sudoku_csp
+
+            self._problem = sudoku_csp(self.geom, self.configs["config"])
+        return self._problem
+
+    def arg(self, spec):
+        import jax
+
+        kind = spec[0]
+        if kind == "array":
+            _, dims, dtype = spec
+            shape = tuple(self._dim(d) for d in dims)
+            return jax.ShapeDtypeStruct(shape, self.dtypes[dtype])
+        if kind == "frontier":
+            return self.frontier(spec[1])
+        if kind == "resident":
+            return self.resident()
+        raise ValueError(f"unknown arg spec {spec!r}")
+
+    def static(self, spec):
+        if isinstance(spec, tuple) and spec and spec[0] == "dim":
+            return self._dim(spec[1])
+        if spec == "geom":
+            return self.geom
+        if isinstance(spec, str) and spec in self.configs:
+            return self.configs[spec]
+        if spec == "mesh":
+            return self.mesh()
+        if spec == "problem":
+            return self.problem()
+        if isinstance(spec, (int, str)):
+            return spec
+        raise ValueError(f"unknown static spec {spec!r}")
+
+
+# -- jaxpr walking -------------------------------------------------------------
+
+
+def _sub_jaxprs(value):
+    """Jaxpr-shaped things hiding inside an eqn param (ClosedJaxpr,
+    Jaxpr, or lists/tuples of either — while/cond/scan/pjit/custom_*)."""
+    if hasattr(value, "eqns"):
+        yield value
+    elif hasattr(value, "jaxpr"):
+        yield value.jaxpr
+    elif isinstance(value, (list, tuple)):
+        for item in value:
+            yield from _sub_jaxprs(item)
+
+
+def iter_eqns(jaxpr):
+    """Every eqn in ``jaxpr`` and all nested sub-jaxprs, depth-first."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def _scan_jaxpr(closed_jaxpr, banned_callbacks, banned_dtypes):
+    """(callback primitive names, banned dtype names, weak invar count)."""
+    jaxpr = closed_jaxpr.jaxpr
+    callbacks: List[str] = []
+    bad_dtypes: set = set()
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name in banned_callbacks:
+            callbacks.append(eqn.primitive.name)
+        for var in tuple(eqn.outvars):
+            dt = getattr(getattr(var, "aval", None), "dtype", None)
+            if dt is not None and dt.name in banned_dtypes:
+                bad_dtypes.add(dt.name)
+    weak = sum(
+        1
+        for v in tuple(jaxpr.invars) + tuple(jaxpr.outvars)
+        if getattr(getattr(v, "aval", None), "weak_type", False)
+    )
+    return callbacks, sorted(bad_dtypes), weak
+
+
+# -- the checker ---------------------------------------------------------------
+
+
+def _load_entry(fnref: str):
+    import importlib
+
+    modpath, attr = fnref.split(":")
+    return getattr(importlib.import_module(modpath), attr)
+
+
+def _rel_modname(fnref: str) -> str:
+    """'distributed_sudoku_solver_tpu.serving.engine:_purge' -> 'serving.engine'."""
+    modpath = fnref.split(":")[0]
+    prefix = _PACKAGE + "."
+    return modpath[len(prefix):] if modpath.startswith(prefix) else modpath
+
+
+class _Anchor:
+    """A line-only AST stand-in so registry-level findings anchor to the
+    entry point's ``def`` line and resolve waivers there."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+
+
+def _def_line(mod: Optional[SourceModule], attr: str) -> int:
+    if mod is None:
+        return 0
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == attr:
+                return node.lineno
+    return 0
+
+
+def _entry_finding(
+    mod: Optional[SourceModule], relmod: str, attr: str, message: str
+) -> Finding:
+    if mod is None:
+        return Finding("jaxck", relmod.replace(".", "/") + ".py", 0, message)
+    line = _def_line(mod, attr)
+    return finding(mod, "jaxck", _Anchor(line), message, def_lines=(line,))
+
+
+def _donation_report(lowered) -> Tuple[int, int]:
+    """(donated flattened-arg count, realized input_output_aliases count)."""
+    import jax.tree_util as jtu
+
+    leaves = jtu.tree_leaves(
+        lowered.args_info, is_leaf=lambda v: hasattr(v, "donated")
+    )
+    donated = sum(1 for a in leaves if a.donated)
+    aliases = lowered.as_text().count("tf.aliasing_output")
+    return donated, aliases
+
+
+def load_golden(path: Path) -> dict:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return {"programs": {}}
+
+
+def check_entry_points(
+    entries: Optional[Sequence[dict]] = None,
+    canon: Optional[dict] = None,
+    golden_path: Optional[Path] = None,
+    mods: Sequence[SourceModule] = (),
+    update_golden: bool = False,
+    banned_callbacks: Optional[Tuple[str, ...]] = None,
+    banned_dtypes: Optional[Tuple[str, ...]] = None,
+) -> Tuple[List[Finding], dict]:
+    """Trace every entry point and prove the four compiled-layer
+    invariants.  Returns ``(findings, summary)`` where summary carries
+    ``drifted`` (program names whose fingerprint moved), ``programs``
+    (the freshly derived golden table) and ``golden_written``.
+
+    Pure tracing: nothing executes, no device is touched, so the rule
+    runs identically on CPU CI and a TPU host.  ``update_golden`` writes
+    the derived table to ``golden_path`` (drift findings are then
+    reported as blessed, not violations).
+    """
+    import warnings
+
+    entries = manifest.ENTRY_POINTS if entries is None else entries
+    canon = manifest.JAXCK_CANON if canon is None else canon
+    golden_path = GOLDEN_PATH if golden_path is None else Path(golden_path)
+    banned_callbacks = (
+        manifest.JAXCK_BANNED_CALLBACKS
+        if banned_callbacks is None
+        else banned_callbacks
+    )
+    banned_dtypes = (
+        manifest.JAXCK_BANNED_DTYPES if banned_dtypes is None else banned_dtypes
+    )
+
+    import jax
+
+    ctx = _Canon(canon)
+    golden = load_golden(golden_path)
+    golden_programs: Dict[str, dict] = dict(golden.get("programs", {}))
+    golden_jax = golden.get("jax")
+    mods_by_name = {m.modname: m for m in mods if m.modname}
+
+    findings: List[Finding] = []
+    programs: Dict[str, dict] = {}
+    drifted: List[str] = []
+
+    for entry in entries:
+        name = entry["name"]
+        relmod = _rel_modname(entry["fn"])
+        attr = entry["fn"].split(":")[1]
+        mod = mods_by_name.get(relmod)
+        # Claim the golden up front: a program that fails to resolve or
+        # trace must neither double-report as a stale golden nor lose
+        # its committed record on --update-golden.
+        old = golden_programs.pop(name, None)
+
+        def report(message: str) -> None:
+            findings.append(_entry_finding(mod, relmod, attr, message))
+
+        def keep_old() -> None:
+            if old is not None:
+                programs[name] = old
+
+        try:
+            fn = _load_entry(entry["fn"])
+            args = tuple(ctx.arg(spec) for spec in entry["args"])
+            static = {k: ctx.static(v) for k, v in entry["static"].items()}
+        except Exception as e:  # noqa: BLE001 - a broken registry entry is a finding
+            report(f"{name}: entry point failed to resolve: {type(e).__name__}: {e}")
+            keep_old()
+            continue
+
+        try:
+            with warnings.catch_warnings():
+                # Donation-unused warnings are OUR diagnostic, counted
+                # below from the lowered text, not a console spray.
+                warnings.simplefilter("ignore")
+                traced = fn.trace(*args, **static)
+                closed = traced.jaxpr
+        except Exception as e:  # noqa: BLE001 - the program not tracing is the finding
+            report(f"{name}: abstract trace failed: {type(e).__name__}: {e}")
+            keep_old()
+            continue
+
+        # -- invariant 2+3: callbacks / dtypes / weak entry avals ----------
+        callbacks, bad_dtypes, weak = _scan_jaxpr(
+            closed, banned_callbacks, banned_dtypes
+        )
+        if entry.get("hot") and callbacks:
+            counts = {p: callbacks.count(p) for p in sorted(set(callbacks))}
+            report(
+                f"{name}: callback in serving-hot program: "
+                + ", ".join(f"{p} x{c}" for p, c in counts.items())
+                + " — a hidden host round-trip per dispatch syncck cannot see"
+            )
+        if bad_dtypes:
+            report(
+                f"{name}: banned dtype(s) {', '.join(bad_dtypes)} in traced "
+                "program — doubles bytes/lane and forks the compile cache"
+            )
+        if weak:
+            report(
+                f"{name}: {weak} weak-typed entry aval(s) — a Python-scalar "
+                "leak into the jit signature retraces per promotion context"
+            )
+
+        # -- invariant 1: donation lowers ----------------------------------
+        # The lowering runs for EVERY program, not just manifest-donated
+        # ones: the lowered args_info is the ground truth, so a
+        # donate_argnums added to (or dropped from) a decorator that the
+        # manifest doesn't agree with is itself a finding — the registry
+        # can't silently under-describe the donation surface.
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                donated, aliases = _donation_report(traced.lower())
+        except Exception as e:  # noqa: BLE001 - ditto: not lowering is the finding
+            report(f"{name}: lowering failed: {type(e).__name__}: {e}")
+            keep_old()
+            continue
+        if entry.get("donate"):
+            if donated == 0:
+                report(
+                    f"{name}: manifest declares donated args but the traced "
+                    "program donates nothing — donate_argnums dropped?"
+                )
+            elif entry.get("donation") == "threads" and aliases < donated:
+                report(
+                    f"{name}: donation did not lower: {aliases}/{donated} "
+                    "donated buffers alias an output (input_output_aliases) "
+                    "— the zero-copy rebind contract is silently broken"
+                )
+        elif donated:
+            report(
+                f"{name}: program donates {donated} buffer(s) but the "
+                "manifest entry declares donate=() — update ENTRY_POINTS "
+                "so the donation invariant actually covers it"
+            )
+
+        # -- invariant 4: HLO-drift golden ---------------------------------
+        text = str(closed)
+        fp = fingerprint(text)
+        eqns = sum(1 for _ in iter_eqns(closed.jaxpr))
+        programs[name] = {
+            "fingerprint": fp,
+            "eqns": eqns,
+            "donated": donated,
+            "aliases": aliases,
+        }
+        if old is None:
+            if not update_golden:
+                report(
+                    f"{name}: no committed golden for this program — run "
+                    "--rule jaxck --update-golden and commit the result"
+                )
+        elif old.get("fingerprint") != fp:
+            drifted.append(name)
+            if not update_golden:
+                version_note = (
+                    f" [goldens were derived under jax {golden_jax}, this "
+                    f"run is jax {jax.__version__} — re-derive under the "
+                    "pinned toolchain]"
+                    if golden_jax not in (None, jax.__version__)
+                    else ""
+                )
+                report(
+                    f"{name}: HLO drift (eqns {old.get('eqns')} -> {eqns}): "
+                    "this PR changes the compiled program and invalidates "
+                    "the XLA cache for it; if intentional, bless with "
+                    "--rule jaxck --update-golden (cold tier-1 recompile "
+                    "is priced in ROADMAP's timing note)" + version_note
+                )
+
+    # Registry shrank but the golden still lists the program: stale data
+    # rots exactly like stale waivers.
+    for name in sorted(golden_programs):
+        findings.append(
+            Finding(
+                "jaxck",
+                "analysis/goldens/jaxck.json",
+                0,
+                f"{name}: golden entry has no ENTRY_POINTS program — "
+                "remove it (or re-run --update-golden)",
+            )
+        )
+
+    findings.extend(_scalar_pin_findings(entries, mods))
+
+    written = False
+    if update_golden:
+        golden_path.parent.mkdir(parents=True, exist_ok=True)
+        # The deriving jax version rides along: fingerprints are stable
+        # per version, not across them — a mismatch turns a wall of
+        # drift findings into a one-line toolchain diagnosis.
+        golden_path.write_text(
+            json.dumps(
+                {"jax": jax.__version__, "programs": programs},
+                indent=2,
+                sort_keys=True,
+            )
+            + "\n"
+        )
+        written = True
+
+    summary = {
+        "programs": programs,
+        "drifted": sorted(drifted),
+        "golden_written": written,
+    }
+    return findings, summary
+
+
+# -- the static half: un-pinned Python scalars at entry call sites -------------
+
+
+def _entry_params(entries, mods_by_name):
+    """(entry modpath, attr) -> (positional param names, static names)."""
+    table = {}
+    for entry in entries:
+        modpath = entry["fn"].split(":")[0]
+        relmod = _rel_modname(entry["fn"])
+        attr = entry["fn"].split(":")[1]
+        mod = mods_by_name.get(relmod)
+        if mod is None:
+            continue
+        for node in mod.tree.body:
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == attr
+            ):
+                params = [a.arg for a in node.args.posonlyargs + node.args.args]
+                table[(modpath, attr)] = (params, set(entry["static"].keys()))
+                break
+    return table
+
+
+def _local_entry_names(mod: SourceModule, table) -> Dict[str, Tuple[str, str]]:
+    """Names that resolve to an entry point INSIDE ``mod``: its own
+    top-level defs plus ``from <entry module> import attr [as alias]``
+    bindings.  Matching on resolved imports — never on a bare trailing
+    name — keeps an unrelated same-named function or method elsewhere in
+    the package from being judged against the entry's parameter table."""
+    names: Dict[str, Tuple[str, str]] = {}
+    own = f"{_PACKAGE}.{mod.modname}" if mod.modname else mod.modname
+    for (modpath, attr) in table:
+        if modpath in (own, mod.modname):
+            names[attr] = (modpath, attr)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                key = (node.module, alias.name)
+                if key in table:
+                    names[alias.asname or alias.name] = key
+    return names
+
+
+def _scalar_pin_findings(
+    entries: Sequence[dict], mods: Sequence[SourceModule]
+) -> List[Finding]:
+    """Flag call sites handing a bare numeric literal to a TRACED
+    parameter of an entry point.  A Python scalar traces as a weak-typed
+    aval, which forks the jit cache against the ``jnp.int32``-pinned
+    spelling every other caller uses — one sloppy call site silently
+    doubles the program's retraces.  Static parameters (part of the jit
+    key by design) are exempt."""
+    mods_by_name = {m.modname: m for m in mods if m.modname}
+    table = _entry_params(entries, mods_by_name)
+    if not table:
+        return []
+    out: List[Finding] = []
+    for mod in mods:
+        local = _local_entry_names(mod, table)
+        if not local:
+            continue
+
+        class _Calls(QualnameVisitor):
+            def __init__(self) -> None:
+                super().__init__()
+                self.sites: List[Tuple[ast.Call, str, Tuple[int, ...]]] = []
+
+            def visit_Call(self, node: ast.Call) -> None:
+                target = call_name(node)
+                if target in local:
+                    self.sites.append((node, target, tuple(self.def_lines)))
+                self.generic_visit(node)
+
+        visitor = _Calls()
+        visitor.visit(mod.tree)
+        for node, target, def_lines in visitor.sites:
+            params, static_names = table[local[target]]
+            flagged = []
+            for pos, a in enumerate(node.args):
+                pname = params[pos] if pos < len(params) else None
+                if pname in static_names:
+                    continue
+                if (
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, (int, float))
+                    and not isinstance(a.value, bool)
+                ):
+                    flagged.append(pname or f"arg {pos}")
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in static_names:
+                    continue
+                if (
+                    isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, (int, float))
+                    and not isinstance(kw.value.value, bool)
+                ):
+                    flagged.append(kw.arg)
+            attr = local[target][1]
+            for pname in flagged:
+                out.append(
+                    finding(
+                        mod,
+                        "jaxck",
+                        node,
+                        f"un-pinned Python scalar for traced param "
+                        f"'{pname}' of {attr}() — weak-type cache fork; "
+                        "wrap in jnp.int32(...)/jnp.asarray(...)",
+                        def_lines=def_lines,
+                    )
+                )
+    return out

@@ -51,6 +51,24 @@ LAYERS = {
     # shared *ck exit-code contract) — importing jax here would break the
     # "<5 s, no jax" acceptance the tier-1 test pins.
     "analysis": {"closed": True, "allow": ("analysis", "obs"), "third_party": ()},
+    # ...with ONE declared exception: jaxck IS the jax lane.  Its jax /
+    # numpy imports and its reach-down into the compute layers (the
+    # eval_shape builders for canonical Frontier specs) are all lazy,
+    # inside functions, behind ``--rule jaxck`` — the fast lane never
+    # executes them, and tests/test_analysis.py pins that the default
+    # run still never imports jax.
+    "analysis.jaxck": {
+        "closed": True,
+        "allow": (
+            "analysis",
+            "obs",
+            "models.geometry",
+            "ops.frontier",
+            "ops.solve",
+            "serving.scheduler",
+        ),
+        "third_party": ("jax", "numpy"),
+    },
     "ops": {
         "closed": False,
         "forbid": ("serving", "cluster", "analysis"),
@@ -135,12 +153,24 @@ SIMNET_RUNTIME_BANNED = (
 # call must either route through the ``host_fetch`` seam
 # (serving/engine.py) or prove its operand host-side (assigned from a
 # ``host_fetch``/``unpack_status`` result — the checker tracks that
-# dataflow) or carry a ``# syncck: allow(<reason>)`` waiver.  Outside the
+# dataflow) or carry a reasoned syncck waiver comment.  Outside the
 # hot regions the same sync-forcing calls are still flagged (waiver
 # required), but the int()/float()-on-indexed-value heuristic only runs
 # inside hot regions — metrics/stats plumbing coerces host ints
 # everywhere and is not the hazard this rule hunts.
-SYNC_SCOPED_FILES = ("serving/engine.py", "serving/scheduler.py")
+#
+# Round 14 extends the proof beyond engine/scheduler to the other two
+# chunked dispatch loops the round-8 rewrite paid for: the bulk rung
+# drain loop (``ops/bulk.py`` — status-riding, buffer-donated advances;
+# one status fetch per dispatch) and the portfolio racer's poll/drain
+# (``serving/portfolio.py`` — the cover race's between-dispatch liveness
+# poll is that loop's one deliberate sync).
+SYNC_SCOPED_FILES = (
+    "serving/engine.py",
+    "serving/scheduler.py",
+    "ops/bulk.py",
+    "serving/portfolio.py",
+)
 
 SYNC_HOT_REGIONS = {
     "serving/engine.py": (
@@ -156,6 +186,14 @@ SYNC_HOT_REGIONS = {
         "ResidentFlight._attach_pending",
         "ResidentFlight._advance",
     ),
+    "ops/bulk.py": (
+        "solve_bulk.run_rung_stepped",
+        "solve_bulk.drain",
+    ),
+    "serving/portfolio.py": (
+        "race_jobs",
+        "race_cover.device_entrant",
+    ),
 }
 
 # Functions whose BODY is the seam (exempt) and whose results prove their
@@ -170,3 +208,237 @@ SYNC_NUMPY_CALLS = ("asarray", "ascontiguousarray")
 SYNC_METHOD_CALLS = ("item", "block_until_ready")
 # jax-module call names that ARE the sync primitive.
 SYNC_JAX_CALLS = ("device_get",)
+
+# -- jaxck ---------------------------------------------------------------
+#
+# The compiled-layer manifest: every jit entry point the serving path
+# prices, declared as DATA so ``analysis/jaxck.py`` can abstractly trace
+# each one at canonical tiny shapes (``jax.jit(...).trace``/``.lower()``
+# — no execution, no device) and prove the four compiled-layer
+# invariants: donation lowers to real ``input_output_aliases``, hot
+# programs are callback-free, dtypes stay disciplined, and the
+# canonicalized jaxpr fingerprint matches the committed golden
+# (``analysis/goldens/jaxck.json``) so HLO drift — which invalidates
+# ``.cache/xla`` for every containing program — is visible and blessed
+# explicitly (``--update-golden``), never a mystery tier-1 slowdown.
+#
+# This module stays jax-free: everything below is strings/ints.  The
+# spec mini-language is resolved by jaxck (the only rule that imports
+# jax, lazily, behind ``--rule jaxck``):
+#
+# * array arg:     ("array", (dims...), dtype)   dims are ints or keys
+#                  into JAXCK_CANON["dims"]
+# * frontier arg:  ("frontier", <config name>)   an abstract Frontier via
+#                  jax.eval_shape over init_frontier_roots at L lanes /
+#                  J jobs of the named canonical config
+# * resident arg:  ("resident",)                 the scheduler's gang
+#                  frontier via eval_shape over _init_resident
+# * static values: "geom" (canonical Geometry), "config"/"config_fused"/
+#                  "config_gang" (canonical SolverConfigs), "mesh"
+#                  (1-device mesh — pinned to ONE device so goldens are
+#                  host-independent), "problem" (sudoku_csp at canon),
+#                  ("dim", name), or a bare int/str literal.
+JAXCK_CANON = {
+    # 4x4 boards, 8 lanes, 4 jobs, 4-deep stacks: the smallest shapes
+    # every entry point accepts (fused kernels included) — tracing cost
+    # is shape-independent, and goldens must be cheap to re-derive.
+    "geom": (2, 2),
+    "dims": {"L": 8, "J": 4, "n": 4, "G": 2, "slots": 4},
+    "configs": {
+        "config": {"lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64},
+        "config_fused": {
+            "lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64,
+            "step_impl": "fused", "fused_steps": 2,
+        },
+        # The resident-scheduler shape: slots gangs of G lanes each.
+        "config_gang": {
+            "lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64,
+            "steal_gang": 2,
+        },
+    },
+}
+
+# One entry per compiled program on the serving/bulk path.  Fields:
+#   name     report id (module-relative dotted path)
+#   fn       "importable.module:attr"
+#   args     dynamic (traced) arg specs, in order
+#   static   static kwargs: param name -> canon spec
+#   donate   flattened-arg indices declared donated (mirrors the
+#            decorator — jaxck cross-checks the lowering, not this tuple)
+#   donation 'threads' = every donated leaf MUST alias an output (the
+#            round-8 zero-copy contract: the caller always rebinds);
+#            'drains' = terminal programs whose donation frees buffers
+#            rather than aliasing them — the alias count is recorded in
+#            the golden (drift-visible) but not asserted
+#   hot      in a serving hot loop: callback primitives are banned
+ENTRY_POINTS = (
+    # serving/engine.py — static-flight lifecycle
+    dict(
+        name="serving.engine._start_roots",
+        fn="distributed_sudoku_solver_tpu.serving.engine:_start_roots",
+        args=(("array", ("L", "n", "n"), "uint32"), ("array", ("L",), "int32")),
+        static={"n_jobs": ("dim", "J"), "config": "config"},
+        donate=(), donation=None, hot=True,
+    ),
+    dict(
+        name="serving.engine._start_packed",
+        fn="distributed_sudoku_solver_tpu.serving.engine:_start_packed",
+        args=(("array", ("L", "n", "n"), "uint32"), ("array", ("L",), "bool")),
+        static={"config": "config"},
+        donate=(), donation=None, hot=True,
+    ),
+    dict(
+        name="serving.engine._purge",
+        fn="distributed_sudoku_solver_tpu.serving.engine:_purge",
+        args=(("frontier", "config"), ("array", ("J",), "bool")),
+        static={},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="serving.engine._shed_jit",
+        fn="distributed_sudoku_solver_tpu.serving.engine:_shed_jit",
+        args=(("frontier", "config"), ("array", (), "int32")),
+        static={"k": 2},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="serving.engine._flight_verdict_jit",
+        fn="distributed_sudoku_solver_tpu.serving.engine:_flight_verdict_jit",
+        args=(("frontier", "config"),),
+        static={},
+        donate=(), donation=None, hot=True,
+    ),
+    dict(
+        name="serving.engine._finalize_jit",
+        fn="distributed_sudoku_solver_tpu.serving.engine:_finalize_jit",
+        args=(("frontier", "config"),),
+        static={},
+        donate=(0,), donation="drains", hot=True,
+    ),
+    # serving/scheduler.py — resident-flight lifecycle
+    dict(
+        name="serving.scheduler._init_resident",
+        fn="distributed_sudoku_solver_tpu.serving.scheduler:_init_resident",
+        args=(),
+        static={"geom": "geom", "config": "config_gang", "n_slots": ("dim", "slots")},
+        donate=(), donation=None, hot=True,
+    ),
+    dict(
+        name="serving.scheduler._attach_jit",
+        fn="distributed_sudoku_solver_tpu.serving.scheduler:_attach_jit",
+        args=(
+            ("resident",),
+            ("array", ("G", "n", "n"), "int32"),
+            ("array", ("G",), "int32"),
+        ),
+        static={"geom": "geom", "gang": ("dim", "G")},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="serving.scheduler._detach_jit",
+        fn="distributed_sudoku_solver_tpu.serving.scheduler:_detach_jit",
+        args=(("resident",), ("array", ("slots",), "bool")),
+        static={},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="serving.scheduler._verdict_jit",
+        fn="distributed_sudoku_solver_tpu.serving.scheduler:_verdict_jit",
+        args=(("resident",),),
+        static={},
+        donate=(), donation=None, hot=True,
+    ),
+    # serving/portfolio.py — the cover-race device entrant's advance
+    dict(
+        name="serving.portfolio._advance_cover",
+        fn="distributed_sudoku_solver_tpu.serving.portfolio:_advance_cover",
+        args=(("frontier", "config"), ("array", (), "int32")),
+        static={"problem": "problem", "config": "config"},
+        donate=(), donation=None, hot=True,
+    ),
+    # ops/bulk.py — escalation-rung lifecycle
+    dict(
+        name="ops.bulk._rung_start",
+        fn="distributed_sudoku_solver_tpu.ops.bulk:_rung_start",
+        args=(("array", ("J", "n", "n"), "uint8"),),
+        static={"geom": "geom", "scfg": "config"},
+        donate=(), donation=None, hot=True,
+    ),
+    dict(
+        name="ops.bulk._rung_finish",
+        fn="distributed_sudoku_solver_tpu.ops.bulk:_rung_finish",
+        args=(("frontier", "config"),),
+        static={"geom": "geom"},
+        donate=(0,), donation="drains", hot=True,
+    ),
+    # utils/checkpoint.py — the composite chunked-advance programs
+    dict(
+        name="utils.checkpoint.start_frontier",
+        fn="distributed_sudoku_solver_tpu.utils.checkpoint:start_frontier",
+        args=(("array", ("J", "n", "n"), "int32"),),
+        static={"geom": "geom", "config": "config"},
+        donate=(), donation=None, hot=True,
+    ),
+    dict(
+        name="utils.checkpoint.advance_frontier",
+        fn="distributed_sudoku_solver_tpu.utils.checkpoint:advance_frontier",
+        args=(("frontier", "config"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="utils.checkpoint.advance_frontier_status",
+        fn="distributed_sudoku_solver_tpu.utils.checkpoint:advance_frontier_status",
+        args=(("frontier", "config"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    # ops/pallas_step.py — the fused twins (abstract tracing never
+    # compiles Mosaic, so these prove out on any backend)
+    dict(
+        name="ops.pallas_step.advance_frontier_fused",
+        fn="distributed_sudoku_solver_tpu.ops.pallas_step:advance_frontier_fused",
+        args=(("frontier", "config_fused"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config_fused"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="ops.pallas_step.advance_frontier_fused_status",
+        fn="distributed_sudoku_solver_tpu.ops.pallas_step:advance_frontier_fused_status",
+        args=(("frontier", "config_fused"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config_fused"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    # parallel/ — the sharded drivers (bulk tier; no donation today, but
+    # their HLO prices the multi-chip cache exactly the same way)
+    dict(
+        name="parallel.sharded._solve_sharded_jit",
+        fn="distributed_sudoku_solver_tpu.parallel.sharded:_solve_sharded_jit",
+        args=(("array", ("J", "n", "n"), "int32"),),
+        static={"geom": "geom", "config": "config", "mesh": "mesh"},
+        donate=(), donation=None, hot=False,
+    ),
+    dict(
+        name="parallel.fused_sharded._solve_fused_sharded_jit",
+        fn="distributed_sudoku_solver_tpu.parallel.fused_sharded:_solve_fused_sharded_jit",
+        args=(("array", ("J", "n", "n"), "int32"),),
+        static={"geom": "geom", "config": "config_fused", "mesh": "mesh"},
+        donate=(), donation=None, hot=False,
+    ),
+    dict(
+        name="parallel.board_sharded._solve_banded_jit",
+        fn="distributed_sudoku_solver_tpu.parallel.board_sharded:_solve_banded_jit",
+        args=(("array", ("J", "n", "n"), "int32"),),
+        static={"geom": "geom", "config": "config", "mesh": "mesh"},
+        donate=(), donation=None, hot=False,
+    ),
+)
+
+# Callback primitives banned from hot jaxprs: each one is a hidden
+# host round-trip syncck cannot see (it fires at run time, inside the
+# compiled program).  ``debug.print`` lowers to debug_callback.
+JAXCK_BANNED_CALLBACKS = ("pure_callback", "io_callback", "debug_callback")
+
+# dtypes banned anywhere in a traced program: f64/c128 double both the
+# bytes-per-lane and the cache key space (x64 flips fork every program).
+JAXCK_BANNED_DTYPES = ("float64", "complex128")

@@ -1,0 +1,49 @@
+"""jaxck fixture programs: one per failure mode the rule must catch.
+
+Loaded by tests/test_jaxck.py under a synthetic module name and driven
+through ``jaxck.check_entry_points`` with an injected registry — never
+imported by the fast lane (which only parses this file's AST, like every
+other fixture here).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def good_thread(x, y):
+    """Donation aliases: same shape/dtype in and out."""
+    return x + y
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def dropped_donation(x, y):
+    """Donated ``x`` has no same-shape/dtype output: the aliasing
+    precondition fails and XLA silently drops the donation."""
+    del x
+    return y.astype(jnp.float32) * 2.0
+
+
+@jax.jit
+def hot_callback(x):
+    """A debug.print in a hot program: a hidden host round-trip."""
+    jax.debug.print("x sum {}", x.sum())
+    return x * 2
+
+
+@jax.jit
+def drifting(x):
+    """The drift seed: tests golden against a changed twin."""
+    return x * 2
+
+
+@jax.jit
+def drifting_changed(x):
+    """Same name in the injected registry, different HLO."""
+    return x * 2 + 1
+
+
+def unpinned_caller(x):
+    return good_thread(x, 3)  # the weak-type cache fork jaxck flags

@@ -272,6 +272,63 @@ def test_def_level_waiver_covers_the_function(tmp_path):
     assert len(findings) == 2 and all(f.waived for f in findings)
 
 
+# -- stale-waiver detection ----------------------------------------------------
+
+def test_stale_waiver_reported_and_used_waiver_is_not(tmp_path, capsys):
+    # One real violation whose waiver is consumed, one waiver whose rule
+    # no longer fires on that line: only the second is stale.
+    p = tmp_path / "w.py"
+    p.write_text(
+        "import time as _t\n\n\n"
+        "def f():\n"
+        "    _t.sleep(1)  # clockck: allow(declared simulator pace)\n"
+        "    x = 1  # clockck: allow(left behind after a refactor)\n"
+        "    return x\n"
+    )
+    from distributed_sudoku_solver_tpu.analysis.common import stale_waivers
+
+    mod = SourceModule(p, "w.py", None)
+    findings = clockck.check_module(
+        mod,
+        manifest.CLOCK_SCOPED_DIRS,
+        manifest.CLOCK_BANNED_CALLS,
+        {},
+        scope_all=True,
+    )
+    assert [f.waived for f in findings] == [True]
+    stale = stale_waivers([mod], ("clockck",))
+    assert stale == [("w.py", 6, "clockck", "left behind after a refactor")]
+    # Scoped to the rules that RAN: clockck's waiver is not stale just
+    # because only lockck ran this time.
+    assert stale_waivers([mod], ("lockck",)) == []
+
+
+def test_strict_waivers_gates_the_exit_code(tmp_path, capsys):
+    p = tmp_path / "w.py"
+    p.write_text("x = 1  # clockck: allow(rule never fires here)\n")
+    root = str(tmp_path)
+    # Report-only by default; --strict-waivers turns stale into exit 1.
+    assert main(["--root", root]) == exitcodes.EXIT_CLEAN
+    assert main(["--root", root, "--strict-waivers"]) == exitcodes.EXIT_VIOLATIONS
+    # Scoping: the stale clockck waiver is invisible to a lockck-only run.
+    assert (
+        main(["--root", root, "--rule", "lockck", "--strict-waivers"])
+        == exitcodes.EXIT_CLEAN
+    )
+    out = capsys.readouterr()
+    assert "stale-waiver" in out.out
+
+
+def test_update_golden_requires_jaxck(capsys):
+    assert main(["--update-golden"]) == exitcodes.EXIT_INTERNAL
+    capsys.readouterr()
+
+
+def test_package_tree_has_no_stale_waivers():
+    report, _ = run()
+    assert report["stale_waivers"] == [], report["stale_waivers"]
+
+
 # -- the tier-1 gate -----------------------------------------------------------
 
 def test_runner_clean_and_jax_free_over_package():

@@ -1,0 +1,299 @@
+"""jaxck gate (analysis/jaxck.py): the compiled-layer invariants.
+
+Lanes:
+* fixture lane — synthetic jit programs (tests/data/analysis/jaxprog.py)
+  driven through ``check_entry_points`` with injected registries, pinning
+  that each failure mode actually FIRES: a dropped donation, an injected
+  callback in a hot program, a drifted-HLO golden, an un-pinned Python
+  scalar at a call site;
+* golden round-trip — ``--update-golden`` writes, a re-check is clean,
+  drift against the written golden is caught, re-blessing clears it;
+* the gate — ``--rule jaxck --json`` over the real tree exits 0 with the
+  committed goldens (covering every donate_argnums program in
+  serving/ops/utils/parallel) and is byte-deterministic across runs;
+* the runtime twin — a retrace guard running a representative serving
+  workload twice and asserting, via jit cache sizes and jax's
+  compilation event hooks, that entry points compile exactly once.
+"""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from distributed_sudoku_solver_tpu.analysis import jaxck, manifest
+from distributed_sudoku_solver_tpu.analysis.common import (
+    ALL_RULES,
+    RULES,
+    SourceModule,
+)
+from distributed_sudoku_solver_tpu.obs import exitcodes
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE = Path(__file__).resolve().parent / "data" / "analysis" / "jaxprog.py"
+
+#: Tiny canon for fixture programs: no frontier/resident specs needed.
+CANON = {"geom": (2, 2), "dims": {"n": 4}, "configs": {}}
+
+
+@pytest.fixture(scope="module")
+def fixture_mod():
+    """The fixture programs, importable as ``jaxck_fixture`` so registry
+    ``fn`` strings resolve through the normal import path."""
+    spec = importlib.util.spec_from_file_location("jaxck_fixture", FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["jaxck_fixture"] = mod
+    spec.loader.exec_module(mod)
+    yield mod
+    sys.modules.pop("jaxck_fixture", None)
+
+
+def entry(name, attr, *, donate=(), donation=None, hot=True, args=2, dtype="uint32"):
+    return dict(
+        name=name,
+        fn=f"jaxck_fixture:{attr}",
+        args=tuple(("array", (4, 4), dtype) for _ in range(args)),
+        static={},
+        donate=donate,
+        donation=donation,
+        hot=hot,
+    )
+
+
+def check(entries, tmp_path, update_golden=False, mods=(), golden="g.json"):
+    findings, summary = jaxck.check_entry_points(
+        entries=entries,
+        canon=CANON,
+        golden_path=tmp_path / golden,
+        mods=mods,
+        update_golden=update_golden,
+    )
+    return findings, summary
+
+
+# -- fixture lane: each failure mode fires -------------------------------------
+
+
+def test_dropped_donation_caught(fixture_mod, tmp_path):
+    entries = (
+        entry("fix.good", "good_thread", donate=(0,), donation="threads"),
+        entry("fix.dropped", "dropped_donation", donate=(0,), donation="threads"),
+    )
+    findings, _ = check(entries, tmp_path, update_golden=True)
+    msgs = [f.message for f in findings]
+    assert len(msgs) == 1, findings
+    assert "fix.dropped" in msgs[0] and "donation did not lower" in msgs[0]
+    assert "0/1 donated buffers alias" in msgs[0]
+
+
+def test_undeclared_donation_caught(fixture_mod, tmp_path):
+    # The inverse failure: the decorator donates but the manifest entry
+    # says donate=() — the registry may never under-describe the
+    # donation surface (the lowering's args_info is the ground truth).
+    entries = (entry("fix.good", "good_thread", donate=()),)
+    findings, _ = check(entries, tmp_path, update_golden=True)
+    assert len(findings) == 1, findings
+    assert "manifest entry declares donate=()" in findings[0].message
+
+
+def test_injected_callback_caught_in_hot_program_only(fixture_mod, tmp_path):
+    hot = (entry("fix.cb", "hot_callback", args=1, dtype="float32", hot=True),)
+    findings, _ = check(hot, tmp_path, update_golden=True)
+    assert len(findings) == 1, findings
+    assert "callback in serving-hot program" in findings[0].message
+    assert "debug_callback" in findings[0].message
+
+    cold = (entry("fix.cb", "hot_callback", args=1, dtype="float32", hot=False),)
+    findings, _ = check(cold, tmp_path, update_golden=True)
+    assert findings == []
+
+
+def test_drift_caught_and_update_golden_round_trip(fixture_mod, tmp_path):
+    v1 = (entry("fix.drift", "drifting", args=1),)
+    v2 = (entry("fix.drift", "drifting_changed", args=1),)
+
+    # No golden yet: reported, not silently clean.
+    findings, _ = check(v1, tmp_path)
+    assert len(findings) == 1 and "no committed golden" in findings[0].message
+
+    # Bless v1; a re-check against the written golden is clean.
+    findings, summary = check(v1, tmp_path, update_golden=True)
+    assert findings == [] and summary["golden_written"]
+    findings, summary = check(v1, tmp_path)
+    assert findings == [] and summary["drifted"] == []
+
+    # The injected HLO change is caught, attributed, priced.
+    findings, summary = check(v2, tmp_path)
+    assert len(findings) == 1, findings
+    assert "HLO drift" in findings[0].message
+    assert "invalidates the XLA cache" in findings[0].message
+    assert summary["drifted"] == ["fix.drift"]
+
+    # Re-bless: drift recorded in the summary, absent from findings.
+    findings, summary = check(v2, tmp_path, update_golden=True)
+    assert findings == [] and summary["drifted"] == ["fix.drift"]
+    findings, _ = check(v2, tmp_path)
+    assert findings == []
+
+
+def test_unpinned_scalar_call_site_caught(fixture_mod, tmp_path):
+    mods = [SourceModule(FIXTURE, "jaxprog.py", "jaxck_fixture")]
+    entries = (entry("fix.good", "good_thread", donate=(0,), donation="threads"),)
+    findings, _ = check(entries, tmp_path, update_golden=True, mods=mods)
+    live = [f for f in findings if not f.waived]
+    assert len(live) == 1, findings
+    assert "un-pinned Python scalar" in live[0].message
+    assert "'y' of good_thread()" in live[0].message
+
+
+def test_stale_golden_entry_reported(fixture_mod, tmp_path):
+    v1 = (entry("fix.drift", "drifting", args=1),)
+    check(v1, tmp_path, update_golden=True)
+    findings, _ = check((), tmp_path)  # program removed from the registry
+    assert len(findings) == 1
+    assert "golden entry has no ENTRY_POINTS program" in findings[0].message
+
+
+# -- the registry covers the donation surface ----------------------------------
+
+
+def test_registry_covers_every_donate_argnums_program():
+    """Completeness pin: every function carrying a ``donate_argnums``
+    decorator in serving/ops/utils/parallel has an ENTRY_POINTS record —
+    so nobody can add a donated program the compiled gate never sees.
+    AST-based: decorator keyword order and line wrapping don't matter."""
+    import ast
+
+    registered = {e["fn"].split(":")[1] for e in manifest.ENTRY_POINTS}
+    pkg = REPO / "distributed_sudoku_solver_tpu"
+    missing = []
+    for sub in ("serving", "ops", "utils", "parallel"):
+        for path in sorted((pkg / sub).glob("*.py")):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                donated = any(
+                    isinstance(dec, ast.Call)
+                    and any(kw.arg == "donate_argnums" for kw in dec.keywords)
+                    for dec in node.decorator_list
+                )
+                if donated and node.name not in registered:
+                    missing.append(f"{path.name}:{node.name}")
+    assert not missing, f"donated programs with no ENTRY_POINTS record: {missing}"
+
+
+def test_default_lane_excludes_jaxck():
+    assert "jaxck" not in RULES
+    assert "jaxck" in ALL_RULES
+
+
+# -- the gate over the real tree -----------------------------------------------
+
+
+def test_jaxck_clean_on_head_and_json_deterministic():
+    """The acceptance pin: ``--rule jaxck`` exits 0 on HEAD against the
+    committed goldens, and two ``--json`` runs are byte-identical (the
+    fingerprints are canonicalized: nothing address- or run-varying
+    survives into the report)."""
+    cmd = [
+        sys.executable, "-m", "distributed_sudoku_solver_tpu.analysis",
+        "--rule", "jaxck", "--json",
+    ]
+    runs = [
+        subprocess.run(cmd, capture_output=True, text=True, cwd=REPO, timeout=300)
+        for _ in range(2)
+    ]
+    for proc in runs:
+        assert proc.returncode == exitcodes.EXIT_CLEAN, (
+            proc.stdout[-4000:], proc.stderr[-4000:],
+        )
+    assert runs[0].stdout == runs[1].stdout
+    report = json.loads(runs[0].stdout)
+    assert report["rules"]["jaxck"]["violations"] == []
+    assert report["jaxck"]["programs"] == len(manifest.ENTRY_POINTS)
+    assert report["jaxck"]["drifted"] == []
+
+
+def test_goldens_committed_for_every_entry_point():
+    golden = json.loads((REPO / "distributed_sudoku_solver_tpu" / "analysis"
+                         / "goldens" / "jaxck.json").read_text())
+    names = {e["name"] for e in manifest.ENTRY_POINTS}
+    assert set(golden["programs"]) == names
+    for name, rec in golden["programs"].items():
+        assert rec["fingerprint"] and rec["eqns"] > 0, name
+
+
+# -- the runtime twin: retrace guard -------------------------------------------
+
+
+def _entry_fns():
+    out = {}
+    for e in manifest.ENTRY_POINTS:
+        try:
+            out[e["name"]] = jaxck._load_entry(e["fn"])
+        except Exception:  # pragma: no cover - import failure is jaxck's beat
+            pass
+    return out
+
+
+def test_retrace_guard_one_compile_per_entry_point():
+    """Run a representative serving workload twice (same shapes, fresh
+    values) and prove, per entry point, exactly one compilation: the
+    second wave adds ZERO cache entries and fires ZERO compile events on
+    jax's monitoring hook.  Sequential single-job submits keep the
+    admission batch width — a static arg — deterministic."""
+    from jax._src import monitoring
+
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9
+
+    fns = _entry_fns()
+    boards = [HARD_9[0], HARD_9[1 % len(HARD_9)]]
+
+    compile_events = []
+    armed = [False]
+
+    def listener(event, **kwargs):
+        if armed[0] and "compile" in event:
+            compile_events.append(event)
+
+    monitoring.register_event_listener(listener)
+    # stack_slots=18 is this guard's private static config: no other test
+    # uses it, so module-level jit caches shared across the pytest
+    # process cannot pre-warm wave 1 — the first wave provably compiles
+    # (delta 1) and the second provably does not (delta 0).
+    eng = SolverEngine(
+        config=SolverConfig(min_lanes=8, stack_slots=18), max_batch=8
+    ).start()
+    try:
+        def wave():
+            for board in boards:
+                job = eng.submit(board)
+                assert job.wait(120) and job.solved
+
+        before = {n: f._cache_size() for n, f in fns.items()}
+        wave()
+        after1 = {n: f._cache_size() for n, f in fns.items()}
+        deltas1 = {n: after1[n] - before[n] for n in fns}
+        # One compilation per entry point the workload exercises — a
+        # retrace fork (weak-type churn, unstable statics) shows as 2+.
+        assert all(d in (0, 1) for d in deltas1.values()), deltas1
+        exercised = {n for n, d in deltas1.items() if d == 1}
+        assert "utils.checkpoint.advance_frontier_status" in exercised, deltas1
+        assert "serving.engine._finalize_jit" in exercised, deltas1
+
+        armed[0] = True
+        wave()
+        armed[0] = False
+        after2 = {n: f._cache_size() for n, f in fns.items()}
+        assert after2 == after1, {
+            n: (after1[n], after2[n]) for n in fns if after1[n] != after2[n]
+        }
+        assert compile_events == [], compile_events
+    finally:
+        eng.stop(timeout=5)
