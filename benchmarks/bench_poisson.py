@@ -212,6 +212,19 @@ def main() -> None:
 
         rec = trace_mod.TraceRecorder(ring=1 << 16)
         trace_mod.install(rec)
+    # Compile accounting for the whole run (obs/compilewatch.py): any
+    # backend compile the run pays — cold process, invalidated .cache/xla
+    # after an HLO change — lands in the artifact's `compile` section, so
+    # benchmarks/regress.py can LABEL a cold-cache run instead of
+    # silently comparing compile noise inside the tolerance band.
+    from distributed_sudoku_solver_tpu.obs import (
+        compilewatch as compilewatch_mod,
+    )
+
+    # A bench run's compiles are accounting, never an alarm: the warmup
+    # window spans the whole run.
+    watch = compilewatch_mod.CompileWatch(warmup_s=1e9)
+    compilewatch_mod.install(watch)
     try:
         out = compare_poisson(
             n_jobs=args.jobs,
@@ -221,6 +234,7 @@ def main() -> None:
             chunk_steps=args.chunk_steps,
         )
     finally:
+        compilewatch_mod.install(None)
         if rec is not None:
             from distributed_sudoku_solver_tpu.obs import trace as trace_mod
 
@@ -233,6 +247,35 @@ def main() -> None:
                 f"({len(doc['traceEvents'])} events)",
                 file=sys.stderr,
             )
+    wm = watch.metrics()
+    out["compile"] = {
+        "programs": {
+            name: {
+                k: v for k, v in rec_.items() if k != "wall_ms"  # hists stay off the artifact
+            }
+            for name, rec_ in wm["programs"].items()
+        },
+        "compiles_total": wm["compiles_total"],
+        "wall_ms_total": round(
+            sum(
+                rec_.get("wall_ms_total", 0.0)
+                for rec_ in wm["programs"].values()
+            ),
+            3,
+        ),
+        "cache": wm["cache"],
+        # Cold = the measured run paid executable builds/loads inside its
+        # window; a warm process (or fully warm persistent cache with a
+        # warm jit cache) reports 0 and stays label-free in regress.
+        "cold": wm["compiles_total"] > 0,
+    }
+    if out["compile"]["cold"]:
+        print(
+            f"cold-cache run: {wm['compiles_total']} compile(s), "
+            f"{out['compile']['wall_ms_total']:.0f} ms compile wall "
+            "inside the measured window",
+            file=sys.stderr,
+        )
     if args.out_json:
         artifact = {
             # Versioned so regress.py can refuse cross-schema compares.
@@ -251,6 +294,7 @@ def main() -> None:
             },
             "rpc_floor_ms": out.get("rpc_floor_ms"),
             "hist": out.get("hist"),
+            "compile": out.get("compile"),
         }
         tmp = args.out_json + ".tmp"
         with open(tmp, "w") as f:

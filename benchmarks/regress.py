@@ -18,6 +18,10 @@ variance (BENCHMARKS.md round-8 3-run note); tighten it on quiet
 hardware.  The rpc_floor estimate is *reported*, not gated — the floor
 is a property of the link, and a changed floor means the environments
 differ, which the report should say out loud rather than fail on.
+Likewise the artifact's ``compile`` section (bench_poisson's
+obs/compilewatch accounting): a cold-cache side is *labeled* — its
+quantiles include compile noise, and a cold-vs-warm compare earns an
+explicit "re-run warm" note instead of hiding inside the band.
 """
 
 from __future__ import annotations
@@ -69,6 +73,32 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
     regressions: List[str] = []
     improvements: List[str] = []
     notes: List[str] = []
+    # Cold-cache labeling (bench_poisson's `compile` section): a run that
+    # paid XLA compiles inside its measured window carries compile noise
+    # in its quantiles — say so out loud instead of silently comparing it
+    # inside the tolerance band.  Older artifacts without the section
+    # stay label-free (comparability is unchanged).
+    cold = {}
+    for label, doc in (("old", old), ("new", new)):
+        sec = doc.get("compile")
+        if isinstance(sec, dict) and sec.get("cold"):
+            cold[label] = sec
+            notes.append(
+                f"{label} artifact is a COLD-CACHE run "
+                f"({sec.get('compiles_total', '?')} compiles, "
+                f"{sec.get('wall_ms_total', 0):.0f} ms compile wall inside "
+                "the measured window) — its quantiles include compile noise"
+            )
+    if set(cold) == {"new"}:
+        notes.append(
+            "cold new vs warm old: an apparent regression may be compile "
+            "noise — re-run the candidate warm before trusting the gate"
+        )
+    elif set(cold) == {"old"}:
+        notes.append(
+            "warm new vs cold old: an apparent improvement may be the "
+            "cache warming, not the code — re-run the baseline warm"
+        )
     for side in SIDES:
         for q in QUANTS:
             o = float(old[side][q])

@@ -353,8 +353,28 @@ def check_entry_points(
     programs: Dict[str, dict] = {}
     drifted: List[str] = []
 
+    # Display names are the compiled layer's shared vocabulary (manifest
+    # note): the production compile watch keys its per-program /metrics
+    # series on them, so a collision would silently merge two programs'
+    # compile counts.  Uniqueness is enforced here, where the registry is
+    # already being proved.
+    seen_displays: Dict[str, str] = {}
+    for entry in entries:
+        disp = manifest.entry_display(entry)
+        if disp in seen_displays:
+            findings.append(
+                Finding(
+                    "jaxck", "analysis/manifest.py", 0,
+                    f"duplicate display name {disp!r} "
+                    f"({seen_displays[disp]} vs {entry['name']}) — "
+                    "compilewatch would merge their compile counts",
+                )
+            )
+        seen_displays[disp] = entry["name"]
+
     for entry in entries:
         name = entry["name"]
+        disp = manifest.entry_display(entry)
         relmod = _rel_modname(entry["fn"])
         attr = entry["fn"].split(":")[1]
         mod = mods_by_name.get(relmod)
@@ -475,7 +495,9 @@ def check_entry_points(
                 report(
                     f"{name}: HLO drift (eqns {old.get('eqns')} -> {eqns}): "
                     "this PR changes the compiled program and invalidates "
-                    "the XLA cache for it; if intentional, bless with "
+                    "the XLA cache for it — a deployed node will recompile "
+                    f"it, and the compile watch will alarm on [compile "
+                    f"{disp}]; if intentional, bless with "
                     "--rule jaxck --update-golden (cold tier-1 recompile "
                     "is priced in ROADMAP's timing note)" + version_note
                 )

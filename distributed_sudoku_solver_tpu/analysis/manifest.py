@@ -40,6 +40,18 @@ from __future__ import annotations
 # the ``serving.faults`` injection seam at ``bulk.dispatch``.
 LAYERS = {
     "obs": {"closed": True, "allow": ("obs",), "third_party": ()},
+    # ...with ONE declared exception inside obs: the compile watch
+    # (obs/compilewatch.py) IS the live jax-compile observability plane —
+    # its jax import is lazy, behind the install seam (production with no
+    # watch installed never executes it), and it reads the pure-data
+    # ENTRY_POINTS registry from analysis/manifest so jaxck, the retrace
+    # guard, and the production watch attribute compilations to ONE
+    # shared program vocabulary.  Mirrors the analysis.jaxck carve-out.
+    "obs.compilewatch": {
+        "closed": True,
+        "allow": ("obs", "analysis.manifest"),
+        "third_party": ("jax",),
+    },
     "serving.faults": {"closed": True, "allow": (), "third_party": ()},
     "cluster.wire": {"closed": True, "allow": (), "third_party": ()},
     "cluster.simnet": {
@@ -260,6 +272,12 @@ JAXCK_CANON = {
 
 # One entry per compiled program on the serving/bulk path.  Fields:
 #   name     report id (module-relative dotted path)
+#   display  short human name, UNIQUE across entries — the shared
+#            vocabulary of the compiled layer: jaxck findings cite it,
+#            the retrace guard keys on it, and the production compile
+#            watch (obs/compilewatch.py) exports per-program /metrics
+#            series under it ("jaxck drift blessed here is what
+#            compilewatch alarms on there")
 #   fn       "importable.module:attr"
 #   args     dynamic (traced) arg specs, in order
 #   static   static kwargs: param name -> canon spec
@@ -274,42 +292,42 @@ JAXCK_CANON = {
 ENTRY_POINTS = (
     # serving/engine.py — static-flight lifecycle
     dict(
-        name="serving.engine._start_roots",
+        name="serving.engine._start_roots", display="start_roots",
         fn="distributed_sudoku_solver_tpu.serving.engine:_start_roots",
         args=(("array", ("L", "n", "n"), "uint32"), ("array", ("L",), "int32")),
         static={"n_jobs": ("dim", "J"), "config": "config"},
         donate=(), donation=None, hot=True,
     ),
     dict(
-        name="serving.engine._start_packed",
+        name="serving.engine._start_packed", display="start_packed",
         fn="distributed_sudoku_solver_tpu.serving.engine:_start_packed",
         args=(("array", ("L", "n", "n"), "uint32"), ("array", ("L",), "bool")),
         static={"config": "config"},
         donate=(), donation=None, hot=True,
     ),
     dict(
-        name="serving.engine._purge",
+        name="serving.engine._purge", display="purge",
         fn="distributed_sudoku_solver_tpu.serving.engine:_purge",
         args=(("frontier", "config"), ("array", ("J",), "bool")),
         static={},
         donate=(0,), donation="threads", hot=True,
     ),
     dict(
-        name="serving.engine._shed_jit",
+        name="serving.engine._shed_jit", display="shed",
         fn="distributed_sudoku_solver_tpu.serving.engine:_shed_jit",
         args=(("frontier", "config"), ("array", (), "int32")),
         static={"k": 2},
         donate=(0,), donation="threads", hot=True,
     ),
     dict(
-        name="serving.engine._flight_verdict_jit",
+        name="serving.engine._flight_verdict_jit", display="flight_verdict",
         fn="distributed_sudoku_solver_tpu.serving.engine:_flight_verdict_jit",
         args=(("frontier", "config"),),
         static={},
         donate=(), donation=None, hot=True,
     ),
     dict(
-        name="serving.engine._finalize_jit",
+        name="serving.engine._finalize_jit", display="finalize",
         fn="distributed_sudoku_solver_tpu.serving.engine:_finalize_jit",
         args=(("frontier", "config"),),
         static={},
@@ -317,14 +335,14 @@ ENTRY_POINTS = (
     ),
     # serving/scheduler.py — resident-flight lifecycle
     dict(
-        name="serving.scheduler._init_resident",
+        name="serving.scheduler._init_resident", display="resident_init",
         fn="distributed_sudoku_solver_tpu.serving.scheduler:_init_resident",
         args=(),
         static={"geom": "geom", "config": "config_gang", "n_slots": ("dim", "slots")},
         donate=(), donation=None, hot=True,
     ),
     dict(
-        name="serving.scheduler._attach_jit",
+        name="serving.scheduler._attach_jit", display="resident_attach",
         fn="distributed_sudoku_solver_tpu.serving.scheduler:_attach_jit",
         args=(
             ("resident",),
@@ -335,14 +353,14 @@ ENTRY_POINTS = (
         donate=(0,), donation="threads", hot=True,
     ),
     dict(
-        name="serving.scheduler._detach_jit",
+        name="serving.scheduler._detach_jit", display="resident_detach",
         fn="distributed_sudoku_solver_tpu.serving.scheduler:_detach_jit",
         args=(("resident",), ("array", ("slots",), "bool")),
         static={},
         donate=(0,), donation="threads", hot=True,
     ),
     dict(
-        name="serving.scheduler._verdict_jit",
+        name="serving.scheduler._verdict_jit", display="resident_verdict",
         fn="distributed_sudoku_solver_tpu.serving.scheduler:_verdict_jit",
         args=(("resident",),),
         static={},
@@ -350,7 +368,7 @@ ENTRY_POINTS = (
     ),
     # serving/portfolio.py — the cover-race device entrant's advance
     dict(
-        name="serving.portfolio._advance_cover",
+        name="serving.portfolio._advance_cover", display="cover_advance",
         fn="distributed_sudoku_solver_tpu.serving.portfolio:_advance_cover",
         args=(("frontier", "config"), ("array", (), "int32")),
         static={"problem": "problem", "config": "config"},
@@ -358,14 +376,14 @@ ENTRY_POINTS = (
     ),
     # ops/bulk.py — escalation-rung lifecycle
     dict(
-        name="ops.bulk._rung_start",
+        name="ops.bulk._rung_start", display="rung_start",
         fn="distributed_sudoku_solver_tpu.ops.bulk:_rung_start",
         args=(("array", ("J", "n", "n"), "uint8"),),
         static={"geom": "geom", "scfg": "config"},
         donate=(), donation=None, hot=True,
     ),
     dict(
-        name="ops.bulk._rung_finish",
+        name="ops.bulk._rung_finish", display="rung_finish",
         fn="distributed_sudoku_solver_tpu.ops.bulk:_rung_finish",
         args=(("frontier", "config"),),
         static={"geom": "geom"},
@@ -373,21 +391,21 @@ ENTRY_POINTS = (
     ),
     # utils/checkpoint.py — the composite chunked-advance programs
     dict(
-        name="utils.checkpoint.start_frontier",
+        name="utils.checkpoint.start_frontier", display="start_frontier",
         fn="distributed_sudoku_solver_tpu.utils.checkpoint:start_frontier",
         args=(("array", ("J", "n", "n"), "int32"),),
         static={"geom": "geom", "config": "config"},
         donate=(), donation=None, hot=True,
     ),
     dict(
-        name="utils.checkpoint.advance_frontier",
+        name="utils.checkpoint.advance_frontier", display="advance",
         fn="distributed_sudoku_solver_tpu.utils.checkpoint:advance_frontier",
         args=(("frontier", "config"), ("array", (), "int32")),
         static={"geom": "geom", "config": "config"},
         donate=(0,), donation="threads", hot=True,
     ),
     dict(
-        name="utils.checkpoint.advance_frontier_status",
+        name="utils.checkpoint.advance_frontier_status", display="advance_status",
         fn="distributed_sudoku_solver_tpu.utils.checkpoint:advance_frontier_status",
         args=(("frontier", "config"), ("array", (), "int32")),
         static={"geom": "geom", "config": "config"},
@@ -396,14 +414,14 @@ ENTRY_POINTS = (
     # ops/pallas_step.py — the fused twins (abstract tracing never
     # compiles Mosaic, so these prove out on any backend)
     dict(
-        name="ops.pallas_step.advance_frontier_fused",
+        name="ops.pallas_step.advance_frontier_fused", display="advance_fused",
         fn="distributed_sudoku_solver_tpu.ops.pallas_step:advance_frontier_fused",
         args=(("frontier", "config_fused"), ("array", (), "int32")),
         static={"geom": "geom", "config": "config_fused"},
         donate=(0,), donation="threads", hot=True,
     ),
     dict(
-        name="ops.pallas_step.advance_frontier_fused_status",
+        name="ops.pallas_step.advance_frontier_fused_status", display="advance_fused_status",
         fn="distributed_sudoku_solver_tpu.ops.pallas_step:advance_frontier_fused_status",
         args=(("frontier", "config_fused"), ("array", (), "int32")),
         static={"geom": "geom", "config": "config_fused"},
@@ -412,27 +430,37 @@ ENTRY_POINTS = (
     # parallel/ — the sharded drivers (bulk tier; no donation today, but
     # their HLO prices the multi-chip cache exactly the same way)
     dict(
-        name="parallel.sharded._solve_sharded_jit",
+        name="parallel.sharded._solve_sharded_jit", display="sharded_solve",
         fn="distributed_sudoku_solver_tpu.parallel.sharded:_solve_sharded_jit",
         args=(("array", ("J", "n", "n"), "int32"),),
         static={"geom": "geom", "config": "config", "mesh": "mesh"},
         donate=(), donation=None, hot=False,
     ),
     dict(
-        name="parallel.fused_sharded._solve_fused_sharded_jit",
+        name="parallel.fused_sharded._solve_fused_sharded_jit", display="fused_sharded_solve",
         fn="distributed_sudoku_solver_tpu.parallel.fused_sharded:_solve_fused_sharded_jit",
         args=(("array", ("J", "n", "n"), "int32"),),
         static={"geom": "geom", "config": "config_fused", "mesh": "mesh"},
         donate=(), donation=None, hot=False,
     ),
     dict(
-        name="parallel.board_sharded._solve_banded_jit",
+        name="parallel.board_sharded._solve_banded_jit", display="banded_solve",
         fn="distributed_sudoku_solver_tpu.parallel.board_sharded:_solve_banded_jit",
         args=(("array", ("J", "n", "n"), "int32"),),
         static={"geom": "geom", "config": "config", "mesh": "mesh"},
         donate=(), donation=None, hot=False,
     ),
 )
+
+# The ONE derivation of an entry's display name (explicit ``display``,
+# else the last dotted component) — jaxck, the retrace guard, and
+# obs/compilewatch all key on it, and a second copy of the fallback rule
+# would let the shared vocabulary fork silently (review-round finding).
+def entry_display(entry: dict) -> str:
+    return entry.get("display") or entry["name"].rsplit(".", 1)[-1]
+
+
+DISPLAY_BY_NAME = {e["name"]: entry_display(e) for e in ENTRY_POINTS}
 
 # Callback primitives banned from hot jaxprs: each one is a hidden
 # host round-trip syncck cannot see (it fires at run time, inside the

@@ -152,6 +152,46 @@ def build_parser() -> argparse.ArgumentParser:
         "(default: <tmpdir>/dsst-flightrec when --trace is on)",
     )
     ap.add_argument(
+        "--no-compile-watch",
+        action="store_true",
+        help="disable the production compile/recompile watch "
+        "(obs/compilewatch.py) — on by default: per-program XLA compile "
+        "counts/walls on /metrics, and after the warmup window an "
+        "unexpected recompile logs [compile <program>], counts, and "
+        "fires one flight-recorder dump per excursion (with --trace)",
+    )
+    ap.add_argument(
+        "--compile-warmup",
+        type=float,
+        default=300.0,
+        help="seconds after boot during which compilations are expected "
+        "(the serving set compiling once); afterwards any compile is an "
+        "unexpected-recompile alarm",
+    )
+    ap.add_argument(
+        "--compile-rearm",
+        type=float,
+        default=300.0,
+        help="quiet seconds after which the one-dump-per-excursion "
+        "recompile alarm re-arms",
+    )
+    ap.add_argument(
+        "--peak-gflops",
+        type=float,
+        default=None,
+        help="the device's peak GFLOP/s (operator-supplied; no backend "
+        "exposes it) — turns the cost plane's achieved-GFLOP/s gauge "
+        "into a device-efficiency ratio against the cost-model ceiling",
+    )
+    ap.add_argument(
+        "--critpath-slow-ms",
+        type=float,
+        default=0.0,
+        help="slow-job watchdog threshold for per-job critical-path "
+        "dumps (obs/critpath.py; needs --trace).  0 = derive from the "
+        "--slo latency objectives (off when neither is set)",
+    )
+    ap.add_argument(
         "--slo",
         type=str,
         default=None,
@@ -337,6 +377,7 @@ def main(argv=None) -> None:
         import os
         import tempfile
 
+        from distributed_sudoku_solver_tpu.obs import critpath as critpath_mod
         from distributed_sudoku_solver_tpu.obs import trace as trace_mod
 
         trace_mod.install(
@@ -344,6 +385,33 @@ def main(argv=None) -> None:
                 ring=args.trace_ring,
                 dump_dir=args.trace_dump
                 or os.path.join(tempfile.gettempdir(), "dsst-flightrec"),
+            )
+        )
+        # Per-job critical-path attribution rides the trace plane (it
+        # decomposes the recorder's stitched spans, so without --trace
+        # there is nothing to attribute).  The slow-job threshold falls
+        # back to the --slo latency objectives when not pinned here.
+        critpath_mod.install(
+            critpath_mod.CritPathMonitor(
+                slow_ms=args.critpath_slow_ms or None
+            )
+        )
+    if not args.no_compile_watch:
+        # The production compile watch is on by default: registering the
+        # jax monitoring listeners costs one global read per compile
+        # event, and the watch resolves the ENTRY_POINTS programs the
+        # node is about to import anyway.  Installed BEFORE the engine
+        # boots so the warmup window covers the serving set's first
+        # compilations.
+        from distributed_sudoku_solver_tpu.obs import (
+            compilewatch as compilewatch_mod,
+        )
+
+        compilewatch_mod.install(
+            compilewatch_mod.CompileWatch(
+                warmup_s=args.compile_warmup,
+                rearm_s=args.compile_rearm,
+                peak_gflops=args.peak_gflops,
             )
         )
     slo_monitor = None

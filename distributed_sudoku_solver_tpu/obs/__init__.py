@@ -20,7 +20,19 @@ through *which* chunks.  This package holds the per-job plane:
   ``/metrics`` dict (``GET /metrics?format=prometheus``).
 * :mod:`obs.logctx` — uuid-carrying log adapters so engine/scheduler/
   cluster records that concern a job are grep-correlatable with its trace.
+* :mod:`obs.compilewatch` — the production compile/recompile watch
+  (round 15): per-program XLA compile counts/walls attributed through
+  the ``analysis/manifest.ENTRY_POINTS`` registry, a post-warmup
+  edge-triggered recompile alarm, and the per-program cost plane
+  (flops/bytes + the live device-efficiency gauge).
+* :mod:`obs.critpath` — per-job critical-path attribution over the
+  stitched traces (round 15): an exact phase partition of each job's
+  wall (``GET /trace/<uuid>?analyze=1``), mergeable per-phase
+  histograms, and the slow-job watchdog.
 
 Import discipline: stdlib only, like ``serving/faults.py`` — every layer
-imports ``obs``; ``obs`` imports none of them back.
+imports ``obs``; ``obs`` imports none of them back.  (One declared
+carve-out: ``obs.compilewatch`` lazily imports jax behind its install
+seam and reads the pure-data ``analysis.manifest`` registry — see
+``manifest.LAYERS``.)
 """

@@ -13,6 +13,14 @@ that merge *soundly*:
   ``validations``) sums.
 * **RPC-floor estimates** (``rpc_floor_ms``) min-merge: the ring's floor
   is the best floor any member has measured.
+* **Compile-watch sections** (``compile``, obs/compilewatch.py) sum
+  per-program compile counts / recompiles / walls (wall histograms
+  vector-add), so "which program is recompiling, cluster-wide?" has one
+  answer; alarm state stays per-node.
+* **Critical-path sections** (``critpath``, obs/critpath.py) sum jobs
+  and per-phase attribution totals; cluster shares are re-derived from
+  the merged totals (the per-phase ``critpath_*_ms`` histograms already
+  merge through the ``hist`` rule above).
 
 Everything else — percentile snapshots, per-geometry breakdowns, string
 state — is deliberately NOT rolled up: those live in the per-node
@@ -40,12 +48,62 @@ SUM_COUNTERS = ("jobs_done", "solved", "validations")
 QUANTILES = (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99))
 
 
+#: Per-program compile-section scalars that sum soundly across members
+#: (lifetime totals, one writer each — the node's own compile watch).
+_COMPILE_SUM_FIELDS = ("count", "recompiles", "wall_ms_total")
+
+
+def _merge_compile(acc: dict, sec: dict) -> None:
+    """Sum one member's ``compile`` section into the rollup: per-program
+    counts/recompiles/walls (the federation the simnet 3-node test pins)
+    plus the totals.  Warmup/armed state is deliberately NOT merged —
+    alarm state is per-node truth and lives in the per-node breakdown."""
+    programs = sec.get("programs")
+    if isinstance(programs, dict):
+        for name in sorted(programs, key=str):
+            rec = programs[name]
+            if not isinstance(rec, dict):
+                continue
+            slot = acc.setdefault("programs", {}).setdefault(str(name), {})
+            for f in _COMPILE_SUM_FIELDS:
+                v = rec.get(f)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    slot[f] = slot.get(f, 0) + v
+            if hist_mod.is_hist(rec.get("wall_ms")):
+                slot["wall_ms"] = hist_mod.merge_hist(
+                    slot.get("wall_ms"), rec["wall_ms"]
+                )
+    for f in ("compiles_total", "recompiles_total", "dumps"):
+        v = sec.get(f)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            acc[f] = acc.get(f, 0) + v
+
+
+def _merge_critpath(acc: dict, sec: dict) -> None:
+    """Sum one member's ``critpath`` section: jobs + per-phase
+    attribution totals (ms sums merge soundly; shares are re-derived
+    from the merged totals — averaging per-node shares would not be)."""
+    for f in ("jobs", "slow_jobs", "slow_dumps"):
+        v = sec.get(f)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            acc[f] = acc.get(f, 0) + v
+    attr = sec.get("attribution_ms")
+    if isinstance(attr, dict):
+        slot = acc.setdefault("attribution_ms", {})
+        for p in sorted(attr, key=str):
+            v = attr[p]
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                slot[str(p)] = slot.get(str(p), 0.0) + v
+
+
 def rollup(bodies: Iterable[Optional[dict]]) -> dict:
     """Merge member ``/metrics`` bodies (None/garbage entries skipped —
     the caller flags those peers unreachable) into the cluster rollup."""
     hists: dict = {}
     counters: dict = {}
     floor: Optional[dict] = None
+    compile_acc: dict = {}
+    critpath_acc: dict = {}
     for body in bodies:
         if not isinstance(body, dict):
             continue
@@ -61,6 +119,10 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
         f = body.get("rpc_floor_ms")
         if hist_mod.is_min_est(f):
             floor = hist_mod.merge_min_est(floor, f)
+        if isinstance(body.get("compile"), dict):
+            _merge_compile(compile_acc, body["compile"])
+        if isinstance(body.get("critpath"), dict):
+            _merge_critpath(critpath_acc, body["critpath"])
     quantiles = {}
     for k, h in hists.items():
         n = hist_mod.hist_count(h)
@@ -76,6 +138,18 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
     out = {"hist": hists, "counters": counters, "quantiles": quantiles}
     if floor is not None:
         out["rpc_floor_ms"] = floor
+    if compile_acc:
+        out["compile"] = compile_acc
+    if critpath_acc:
+        total = sum(
+            v for v in critpath_acc.get("attribution_ms", {}).values()
+        )
+        if total > 0:
+            critpath_acc["shares_pct"] = {
+                p: round(100.0 * v / total, 2)
+                for p, v in critpath_acc["attribution_ms"].items()
+            }
+        out["critpath"] = critpath_acc
     return out
 
 

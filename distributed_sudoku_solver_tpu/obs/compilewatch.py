@@ -1,0 +1,520 @@
+"""Production compile/recompile watch: device-time truth for the XLA layer.
+
+PR 11's jaxck proves the compiled layer *at lint time* (donation lowers,
+hot programs are callback-free, HLO drift is blessed explicitly), and its
+retrace guard proves one-compilation-per-program *at test time*.  In
+production neither runs: a silent recompile storm — a weak-type cache
+fork, an unstable static, an XLA-cache invalidation after a deploy — is
+invisible until it shows up as a mystery latency cliff.  This module is
+the live third leg:
+
+* **Attribution.**  jax's monitoring events
+  (``/jax/core/compile/backend_compile_duration``) say *that* an
+  executable was built and how long it took, but not *which* program.
+  The watch attributes compilations the same way the retrace guard does:
+  per-program jit-cache sizes (``fn._cache_size()``) for every
+  ``analysis/manifest.ENTRY_POINTS`` program, polled when an event
+  fires.  Cache growth is the ground truth for **counts** (exact);
+  event durations pair with growth FIFO, so **walls** are exact for
+  serialized compiles and best-effort inside a concurrent burst.
+  Compilations no registered program accounts for attribute to
+  ``unregistered``.
+* **Warmup, then alarm.**  Compilations during the warmup window
+  (``warmup_s`` after construction, or until :meth:`CompileWatch.seal`)
+  are expected — a booting node compiles its serving set once.  After
+  warmup, ANY attributed compilation is an *unexpected recompile*: a
+  ``[compile <program>]`` log line, a per-program ``recompiles``
+  counter, a trace event, and — edge-triggered — exactly ONE
+  flight-recorder dump (``trace.active().dump("recompile", ...)``) per
+  excursion.  The alarm re-arms after ``rearm_s`` seconds with no
+  further recompiles (recovery), so a storm costs one dump, not one per
+  compile.  This is jaxck's "this PR invalidates the XLA cache for N
+  programs" lint message promoted to a live production alarm.
+* **Cost plane.**  The serving loops call :meth:`capture_cost` once per
+  (program, shape) at flight birth: the program is re-traced via
+  ``jit(...).lower(...)`` (host-side, no execution, no device sync, no
+  backend compile — so no self-noise on the event listener) and
+  ``Lowered.cost_analysis()`` records flops / bytes accessed from the
+  unoptimized HLO.  For the chunked advance programs the dominant
+  ``while``-loop body is costed once, i.e. the figure is per frontier
+  ROUND — which is exactly the unit the engine's
+  ``step_wall_ms_avg`` measures, so ``/metrics`` derives a live
+  device-efficiency gauge (achieved GFLOP/s = flops-per-round x
+  measured rounds/s; with ``peak_gflops`` configured, the ratio against
+  the cost-model ceiling).  Peak-temp memory analysis is deliberately
+  NOT captured: it needs ``.compile()``, which would double-compile
+  every program outside the runtime cache and fire the very events this
+  module watches.
+
+**Hot-path contract** (the trace/slo/faults pattern): the jax listeners
+are registered ONCE, process-wide, and forward through the
+``active()`` seam — with no watch installed each compile event costs one
+global read + one branch, and the serving loops' cost seam is likewise
+one global read + branch (plus, when installed, one set-membership test
+per flight birth, never per chunk).  Nothing here ever reads a device
+value: **zero added host syncs**, enforced by the round-8 fetch-count
+guard running with the watch installed.
+
+Import discipline: stdlib + sibling ``obs`` modules + the pure-data
+``analysis.manifest`` registry (the declared layerck carve-out, like
+jaxck's); jax is imported lazily inside the install/construction paths
+only.  Clock-injectable (``clock=``) so warmup/re-arm edges are
+deterministic under test.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from distributed_sudoku_solver_tpu.analysis import manifest
+from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
+from distributed_sudoku_solver_tpu.obs.logctx import ctx_log
+
+_LOG = logging.getLogger(__name__)
+
+#: The one event that means "an XLA executable was built (or pulled from
+#: the persistent cache) for a program" — jax._src.dispatch
+#: BACKEND_COMPILE_EVENT, pinned as a literal so this module stays
+#: importable without jax.
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: Persistent-cache health events (record_event, no duration): cold vs
+#: disk-warm is visible without guessing from wall times.
+CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "persistent_cache_hits",
+    "/jax/compilation_cache/cache_misses": "persistent_cache_misses",
+}
+
+#: Canonical ENTRY_POINTS names of the serving advance programs — the
+#: cost-seam call sites in serving/engine.py and serving/scheduler.py
+#: name their program through these, so the strings live in one place.
+ADVANCE_STATUS = "utils.checkpoint.advance_frontier_status"
+ADVANCE_FUSED_STATUS = "ops.pallas_step.advance_frontier_fused_status"
+
+#: The attribution bucket for compilations no registered program grew for.
+UNREGISTERED = "unregistered"
+
+
+def display_name(entry_name: str) -> str:
+    """The short display name shared with jaxck — the manifest's ONE
+    derivation (``manifest.entry_display``), looked up by entry name."""
+    return manifest.DISPLAY_BY_NAME.get(
+        entry_name, entry_name.rsplit(".", 1)[-1]
+    )
+
+
+def _load_programs() -> dict:
+    """display name -> live jit callable for every resolvable
+    ENTRY_POINTS program (imports the serving/ops/parallel modules; an
+    unresolvable entry is skipped and reported in metrics)."""
+    import importlib
+
+    out: dict = {}
+    unresolved: list = []
+    for e in manifest.ENTRY_POINTS:
+        disp = manifest.entry_display(e)
+        modpath, attr = e["fn"].split(":")
+        try:
+            fn = getattr(importlib.import_module(modpath), attr)
+            fn._cache_size()  # must quack like a jit function
+        except Exception as exc:  # noqa: BLE001 - a missing backend is survivable
+            unresolved.append(f"{disp}: {type(exc).__name__}")
+            continue
+        out[disp] = fn
+    if unresolved:
+        _LOG.warning(
+            "[compilewatch] %d entry point(s) unresolved: %s",
+            len(unresolved), "; ".join(unresolved),
+        )
+    return out
+
+
+class CompileWatch:
+    """Per-program compile accounting plus the post-warmup recompile alarm.
+
+    ``programs`` maps display name -> an object with ``_cache_size()``
+    (default: every resolvable ``manifest.ENTRY_POINTS`` program);
+    ``warmup_s`` is the expected-compilation window after construction
+    (``seal()`` ends it early); ``rearm_s`` is the quiet period after
+    which the one-dump-per-excursion alarm re-arms; ``peak_gflops``
+    (optional, operator-supplied — no backend exposes it) turns the
+    achieved-GFLOP/s gauge into a ceiling ratio.  All timing through the
+    injectable ``clock``.
+    """
+
+    def __init__(
+        self,
+        programs: Optional[dict] = None,
+        warmup_s: float = 300.0,
+        rearm_s: float = 300.0,
+        peak_gflops: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._clock = clock
+        self.rearm_s = float(rearm_s)
+        self.peak_gflops = peak_gflops
+        self._lock = threading.Lock()
+        self._fns = dict(programs) if programs is not None else _load_programs()
+        self._last_size = {}
+        for name, fn in self._fns.items():
+            try:
+                self._last_size[name] = int(fn._cache_size())
+            except Exception:  # noqa: BLE001 - treat as empty, count from 0
+                self._last_size[name] = 0
+        self.counts: dict = {}  # display -> compilations since install
+        self.recompiles: dict = {}  # display -> post-warmup compilations
+        self.wall: dict = {}  # display -> LatencyHistogram (compile wall)
+        self.wall_ms_total: dict = {}  # display -> float
+        self._pending: collections.deque = collections.deque()  # (dur_s, t)
+        self.cache_events = {v: 0 for v in CACHE_EVENTS.values()}
+        self.compiles_total = 0
+        self.recompiles_total = 0
+        self.dumps = 0
+        now = self._clock()
+        self.installed_at = now
+        self._warmup_until = now + max(0.0, float(warmup_s))
+        self._armed = True  # the edge trigger: one dump per excursion
+        self._last_unexpected: Optional[float] = None
+        # Cost plane: display -> {flops, bytes_accessed, ...meta}; the
+        # seen-set bounds lowering to once per (program, shape) and is
+        # exposed read-only for the hot loops' cheap membership guard.
+        self.costs: dict = {}
+        self.cost_keys: set = set()
+        self.cost_errors = 0
+
+    # -- warmup / alarm edges -------------------------------------------------
+    def seal(self) -> None:
+        """End the warmup window now: every later compilation is an
+        unexpected recompile (tests and short-boot deployments)."""
+        with self._lock:
+            self._warmup_until = self._clock()
+
+    def warmup_over(self) -> bool:
+        return self._clock() >= self._warmup_until
+
+    # -- the event feed (via the module-level forwarders) ---------------------
+    def on_duration(self, event: str, duration_s: float) -> None:
+        """One jax duration event.  Backend-compile events first attribute
+        every already-inserted pending compile (the event for compile N
+        fires BEFORE N's cache insertion, so the poll sees 1..N-1), then
+        queue this one."""
+        if event != BACKEND_COMPILE_EVENT:
+            return
+        actions = []
+        with self._lock:
+            actions = self._attribute_locked()
+            self._pending.append((float(duration_s), self._clock(), 0))
+        self._run_actions(actions)
+
+    def on_event(self, event: str) -> None:
+        key = CACHE_EVENTS.get(event)
+        if key is not None:
+            with self._lock:
+                self.cache_events[key] += 1
+
+    def poll(self) -> None:
+        """Attribute anything outstanding (reads call this so the last
+        compile of a burst doesn't wait for the next event)."""
+        with self._lock:
+            actions = self._attribute_locked()
+        self._run_actions(actions)
+
+    def _attribute_locked(self) -> list:
+        """Pair pending compile walls with per-program cache growth.
+        Returns deferred actions (log/dump/trace) to run OUTSIDE the lock
+        — the dump path re-enters the recorder and must not nest.
+
+        A pending whose cache growth has not appeared yet may just be
+        in the event-before-insertion window (the compile that fired the
+        event is still being cached), so leftovers only fall through to
+        ``unregistered`` after SURVIVING one full earlier attribution
+        pass — a mid-window /metrics scrape can therefore never
+        misattribute a registered program's compile (and never fire a
+        phantom recompile alarm for it)."""
+        grown: list = []
+        for name, fn in self._fns.items():
+            try:
+                size = int(fn._cache_size())
+            except Exception:  # noqa: BLE001 - a dead fn stops counting, not the watch
+                continue
+            d = size - self._last_size.get(name, 0)
+            if d > 0:
+                self._last_size[name] = size
+                grown.extend([name] * d)
+        actions: list = []
+        while grown:
+            name = grown.pop(0)
+            if self._pending:
+                dur, t, _seen = self._pending.popleft()
+            else:
+                dur, t = None, self._clock()
+            actions.extend(self._note_locked(name, dur, t))
+        # Leftover pendings: either genuinely unregistered compiles or
+        # registered ones whose insertion this poll raced — the former
+        # survive a second pass unmatched, the latter pair next time.
+        survivors: collections.deque = collections.deque()
+        while self._pending:
+            dur, t, seen = self._pending.popleft()
+            if seen >= 1:
+                actions.extend(self._note_locked(UNREGISTERED, dur, t))
+            else:
+                survivors.append((dur, t, seen + 1))
+        self._pending = survivors
+        return actions
+
+    def _note_locked(self, name: str, dur_s, t: float) -> list:
+        self.counts[name] = self.counts.get(name, 0) + 1
+        self.compiles_total += 1
+        if dur_s is not None:
+            self.wall.setdefault(name, LatencyHistogram()).record(dur_s)
+            self.wall_ms_total[name] = (
+                self.wall_ms_total.get(name, 0.0) + dur_s * 1e3
+            )
+        if t < self._warmup_until:
+            return []
+        # Post-warmup: an unexpected recompile.  Re-arm first (recovery =
+        # rearm_s of quiet since the last one), then edge-trigger.
+        self._rearm_locked(t)
+        self.recompiles[name] = self.recompiles.get(name, 0) + 1
+        self.recompiles_total += 1
+        self._last_unexpected = t
+        fire_dump = self._armed
+        if fire_dump:
+            self._armed = False
+            self.dumps += 1
+        payload = {
+            "program": name,
+            "wall_ms": None if dur_s is None else round(dur_s * 1e3, 3),
+            "recompiles": dict(self.recompiles),
+            "counts": dict(self.counts),
+        }
+        return [(name, fire_dump, payload)]
+
+    def _rearm_locked(self, now: float) -> None:
+        if (
+            not self._armed
+            and self._last_unexpected is not None
+            and now - self._last_unexpected >= self.rearm_s
+        ):
+            self._armed = True
+            ctx_log(_LOG, "compile", "watch").info(
+                "recompile alarm re-armed after %.0fs quiet", self.rearm_s
+            )
+
+    def _run_actions(self, actions: list) -> None:
+        for name, fire_dump, payload in actions:
+            ctx_log(_LOG, "compile", name).warning(
+                "unexpected recompilation after warmup (wall %s ms) — %s",
+                payload["wall_ms"],
+                "flight-recorder dump triggered"
+                if fire_dump
+                else "alarm already fired this excursion",
+            )
+            rec = trace.active()
+            if rec is None:
+                continue
+            rec.event(
+                None, "compile", "xla.compile", program=name,
+                wall_ms=payload["wall_ms"],
+            )
+            if fire_dump:
+                rec.dump("recompile", metrics=payload)
+
+    # -- the cost plane -------------------------------------------------------
+    def capture_cost(self, name: str, key, lower_thunk, **meta) -> None:
+        """Record the cost model of one program at one live shape.
+
+        ``name`` is the canonical ENTRY_POINTS name; ``key`` dedupes per
+        (program, shape) so the lowering runs once per shape ever;
+        ``lower_thunk`` returns a ``jax.stages.Lowered`` (the caller
+        closes over its live args — lowering re-traces on the host, no
+        execution, no sync).  Never raises: a cost model is evidence,
+        not a dependency."""
+        full_key = (name,) + tuple(key)
+        with self._lock:
+            if full_key in self.cost_keys:
+                return
+            self.cost_keys.add(full_key)
+        disp = display_name(name)
+        try:
+            import warnings
+
+            with warnings.catch_warnings():
+                # Donation-unused warnings are jaxck's beat; re-lowering
+                # for a cost model must not re-spray them (same policy
+                # as analysis/jaxck.py's lowering).
+                warnings.simplefilter("ignore")
+                ca = lower_thunk().cost_analysis() or {}
+            cost = {
+                "flops": float(ca.get("flops", 0.0)),
+                "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+            }
+            if "transcendentals" in ca:
+                cost["transcendentals"] = float(ca["transcendentals"])
+        except Exception as e:  # noqa: BLE001 - see docstring
+            with self._lock:
+                self.cost_errors += 1
+            _LOG.debug("[compilewatch] cost capture failed for %s: %r", disp, e)
+            return
+        entry = {**cost, **{k: v for k, v in meta.items()}}
+        with self._lock:
+            # Latest captured shape wins the section entry, but the
+            # shape COUNT rides along: the efficiency gauge refuses to
+            # price mixed-shape serving with one shape's flops (see
+            # ``efficiency``), and the count tells the operator why.
+            entry["shapes_captured"] = sum(
+                1 for k in self.cost_keys if k[0] == name
+            )
+            self.costs[disp] = entry
+
+    def efficiency(self, name: str, rounds: int, wall_s: float) -> Optional[dict]:
+        """The live device-efficiency gauge: the measured serving rate
+        (frontier rounds/s from the engine's chunk totals) priced by the
+        captured per-round cost model.  With ``peak_gflops`` configured
+        the ratio against the cost-model ceiling rides along.
+
+        Honest only for shape-homogeneous serving: the engine's round
+        totals span every flight shape, so once more than one shape of
+        the program has been captured, pricing them all with one shape's
+        flops would be off by the per-round flops ratio between shapes —
+        the gauge is suppressed instead (``suppressed: mixed_shapes``;
+        the cost entry's ``shapes_captured`` says why)."""
+        disp = display_name(name)
+        with self._lock:
+            cost = self.costs.get(disp)
+        if cost is None or rounds <= 0 or wall_s <= 0:
+            return None
+        if cost.get("shapes_captured", 1) > 1:
+            return {
+                "program": disp,
+                "suppressed": "mixed_shapes",
+                "shapes_captured": int(cost["shapes_captured"]),
+            }
+        flops = cost.get("flops", 0.0)
+        rounds_per_s = rounds / wall_s
+        out = {
+            "program": disp,
+            "flops_per_round": flops,
+            "achieved_rounds_per_s": round(rounds_per_s, 3),
+            "achieved_gflops_per_s": round(flops * rounds_per_s / 1e9, 6),
+        }
+        if self.peak_gflops:
+            out["peak_gflops"] = float(self.peak_gflops)
+            if flops > 0:
+                # The cost-model ceiling: rounds/s if the device did
+                # nothing but this program at peak throughput.
+                ceiling = self.peak_gflops * 1e9 / flops
+                out["ceiling_rounds_per_s"] = round(ceiling, 3)
+                out["device_efficiency"] = round(rounds_per_s / ceiling, 6)
+        return out
+
+    # -- reads ----------------------------------------------------------------
+    def program_counts(self) -> dict:
+        """display -> compilations since install (attribution ground
+        truth: per-program jit-cache growth)."""
+        self.poll()
+        with self._lock:
+            return dict(self.counts)
+
+    def metrics(self) -> dict:
+        self.poll()
+        with self._lock:
+            now = self._clock()
+            self._rearm_locked(now)
+            programs = {}
+            for name in sorted(set(self.counts) | set(self.recompiles)):
+                rec: dict = {"count": int(self.counts.get(name, 0))}
+                if self.recompiles.get(name):
+                    rec["recompiles"] = int(self.recompiles[name])
+                if name in self.wall_ms_total:
+                    rec["wall_ms_total"] = round(self.wall_ms_total[name], 3)
+                if name in self.wall:
+                    rec["wall_ms"] = self.wall[name].to_dict()
+                programs[name] = rec
+            return {
+                "programs": programs,
+                "registered": len(self._fns),
+                "compiles_total": int(self.compiles_total),
+                "recompiles_total": int(self.recompiles_total),
+                "warmup_over": now >= self._warmup_until,
+                "armed": self._armed,
+                "dumps": int(self.dumps),
+                "cache": dict(self.cache_events),
+            }
+
+    def cost_metrics(self) -> Optional[dict]:
+        with self._lock:
+            if not self.costs and not self.cost_errors:
+                return None
+            out: dict = {"programs": {k: dict(v) for k, v in self.costs.items()}}
+            if self.cost_errors:
+                out["errors"] = int(self.cost_errors)
+            return out
+
+
+# -- the process-wide seam ----------------------------------------------------
+#
+# Mirrors obs/trace.py and obs/slo.py.  The jax listeners are registered
+# exactly once (jax's monitoring API has no public unregister) and forward
+# through the global — uninstalled, each event costs one read + one branch.
+
+_active: Optional[CompileWatch] = None
+_listeners_registered = False
+
+
+def _forward_duration(event, duration_secs, **kw):
+    w = _active
+    if w is None:
+        return
+    try:
+        w.on_duration(event, duration_secs)
+    except Exception:  # noqa: BLE001 - never raise into jax's compile path
+        _LOG.exception("[compilewatch] duration listener failed")
+
+
+def _forward_event(event, **kw):
+    w = _active
+    if w is None:
+        return
+    try:
+        w.on_event(event)
+    except Exception:  # noqa: BLE001 - never raise into jax's compile path
+        _LOG.exception("[compilewatch] event listener failed")
+
+
+def _ensure_listeners() -> None:
+    global _listeners_registered
+    if _listeners_registered:
+        return
+    from jax._src import monitoring  # lazy: obs stays importable without jax
+
+    monitoring.register_event_duration_secs_listener(_forward_duration)
+    monitoring.register_event_listener(_forward_event)
+    _listeners_registered = True
+
+
+def install(watch: Optional[CompileWatch]) -> None:
+    global _active
+    if watch is not None:
+        _ensure_listeners()
+    _active = watch
+
+
+def active() -> Optional[CompileWatch]:
+    return _active
+
+
+@contextlib.contextmanager
+def installed(watch: CompileWatch):
+    """Scope a watch over a block (tests): always uninstalls."""
+    install(watch)
+    try:
+        yield watch
+    finally:
+        install(None)

@@ -1,0 +1,327 @@
+"""Per-job critical-path attribution over PR-8 stitched traces.
+
+The trace plane answers "what happened to job X"; the SLO plane answers
+"are we inside budget".  Neither answers the question an operator asks
+when p95 blows up: *where did the time go* — queue wait, device chunks,
+the host's one sync per chunk, the cluster wire, recovery churn?  This
+module closes that gap with a deterministic decomposition of a job's
+stitched spans into named phases:
+
+=============  ===========================================================
+phase          spans attributed to it
+=============  ===========================================================
+``sync``       ``chunk.sync`` / ``resident.sync`` (site ``fetch.status``)
+               — the host blocked in the one per-chunk status fetch,
+               which through a tunnel includes the RPC floor and on any
+               backend includes un-overlapped device compute
+``event``      ``verdict.sync`` / ``finalize.sync`` (``fetch.event`` /
+               ``fetch.finalize``) — the rarer resolution-chunk fetches
+``dispatch``   ``chunk.dispatch`` / ``resident.chunk.dispatch`` — host
+               time enqueueing device work (async; should stay thin)
+``wire``       ``send.*`` / ``recv.*`` — cluster frames carrying the job
+``recovery``   ``recovery.*`` / ``fault.*`` / ``breaker`` transitions
+``queue``      the ``admission`` span — submit to flight launch /
+               resident attach
+``other``      the remainder of the job window no span covers (host
+               scheduling gaps, the engine loop serving other flights)
+=============  ===========================================================
+
+**The decomposition is a partition, not a sum of span walls.**  Spans
+overlap (the always-ahead loop dispatches chunk k+1 while chunk k's sync
+blocks; a flight-level chunk span covers many jobs), so naive summing
+double-counts.  :func:`decompose` instead sweeps the job's window
+``[earliest span t0, resolve t1]`` as disjoint segments, attributing each
+segment to the highest-priority covering phase (priority = the table
+order above, ``sync`` first).  Phase walls therefore sum to the job's
+end-to-end wall *exactly* (float rounding aside — the pinned tolerance is
+0.1%), on any clock the recorder was driven by: the simnet virtual clock
+and a real wall clock decompose identically.
+
+Surfaces:
+
+* ``GET /trace/<uuid>?analyze=1`` (``serving/http.py``) — the per-job
+  decomposition next to the raw spans.
+* :class:`CritPathMonitor` (the ``install``/``active``/``installed``
+  seam) — fed by ``SolverEngine._finish_job`` when BOTH a recorder and
+  the monitor are installed: per-phase mergeable histograms
+  (``critpath_<phase>_ms``, exported inside the engine's ``hist``
+  section so ``obs/agg.py`` vector-adds them cluster-wide), cumulative
+  per-phase attribution shares, and the **slow-job watchdog**: a job
+  whose wall breaches the SLO-derived threshold (the smallest latency
+  objective on the ``--slo`` plane, or an explicit ``slow_ms``)
+  auto-dumps its critical path through the PR-8 flight recorder
+  (``dump("slow_job", ...)``), cooldown-limited so a storm costs one
+  dump per window, not one per job.
+
+Hot-path contract: the engine reaches the monitor only inside its
+existing ``rec is not None`` branch — untraced serving pays nothing new;
+traced serving pays one ring scan per *resolved job* (host-side, zero
+device syncs — the round-8 fetch-count guard runs with the monitor
+installed to prove it).
+
+Import discipline: stdlib + sibling ``obs`` modules only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from distributed_sudoku_solver_tpu.obs import slo as slo_mod
+from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
+from distributed_sudoku_solver_tpu.obs.logctx import job_log
+
+_LOG = logging.getLogger(__name__)
+
+#: Phase names in priority order (highest first) — the order segments are
+#: claimed when spans overlap.  ``other`` is the residual, never claimed.
+PHASES = ("sync", "event", "dispatch", "wire", "recovery", "queue")
+ALL_PHASES = PHASES + ("other",)
+
+#: Documented sum tolerance: the decomposition is an exact partition, so
+#: phase walls and end-to-end may differ only by float rounding.
+SUM_TOLERANCE = 1e-3  # 0.1%
+
+_SYNC_SITES = frozenset(("fetch.status",))
+_EVENT_SITES = frozenset(("fetch.event", "fetch.finalize"))
+_DISPATCH_SITES = frozenset(("engine.advance", "resident.advance"))
+_RECOVERY_SITES = frozenset(("engine.recovery", "resident.breaker"))
+
+
+def classify(span: dict) -> Optional[str]:
+    """Phase of one span, or None for markers (http.solve, resolve,
+    compile events) that bound the window but claim no time themselves."""
+    site = span.get("site") or ""
+    name = span.get("name") or ""
+    if site in _SYNC_SITES:
+        return "sync"
+    if site in _EVENT_SITES:
+        return "event"
+    if site in _DISPATCH_SITES:
+        return "dispatch"
+    if name.startswith("send.") or name.startswith("recv."):
+        return "wire"
+    if (
+        site in _RECOVERY_SITES
+        or name.startswith("recovery.")
+        or name.startswith("fault.")
+        or name == "breaker"
+    ):
+        return "recovery"
+    if name == "admission":
+        return "queue"
+    return None
+
+
+def decompose(spans: List[dict]) -> Optional[dict]:
+    """Decompose one job's spans into the phase partition.
+
+    ``spans`` is the recorder's stitched span list for a single trace
+    (``TraceRecorder.spans(uuid)``).  Returns None when the spans carry
+    no usable window (empty, or zero-width).  The result's
+    ``phases`` (ms) sum to ``end_to_end_ms`` within ``SUM_TOLERANCE``
+    by construction — pinned in tests on both the simnet virtual clock
+    and a real run.
+    """
+    if not spans:
+        return None
+    t_start = min(float(s["t0"]) for s in spans)
+    resolve = [s for s in spans if s.get("name") == "resolve"]
+    t_end = (
+        max(float(s["t1"]) for s in resolve)
+        if resolve
+        else max(float(s["t1"]) for s in spans)
+    )
+    if t_end <= t_start:
+        return None
+    # Clip phase intervals into the window; markers claim nothing.
+    intervals: List[Tuple[float, float, int]] = []  # (t0, t1, priority idx)
+    for s in spans:
+        phase = classify(s)
+        if phase is None:
+            continue
+        a = max(t_start, float(s["t0"]))
+        b = min(t_end, float(s["t1"]))
+        if b > a:
+            intervals.append((a, b, PHASES.index(phase)))
+    phases = {p: 0.0 for p in ALL_PHASES}
+    # Sweep line, O(n log n): a long job's trace can carry thousands of
+    # chunk spans and this runs on the device loop at resolve time — a
+    # per-segment interval scan would be quadratic there.
+    events = []
+    for a, b, pri in intervals:
+        events.append((a, 1, pri))
+        events.append((b, -1, pri))
+    events.sort()
+    bounds = sorted({t_start, t_end} | {e[0] for e in events})
+    active = [0] * len(PHASES)
+    ei = 0
+    for a, b in zip(bounds, bounds[1:]):
+        while ei < len(events) and events[ei][0] <= a:
+            _, d, pri = events[ei]
+            active[pri] += d
+            ei += 1
+        best = next((i for i, n in enumerate(active) if n > 0), None)
+        phases[PHASES[best] if best is not None else "other"] += b - a
+    end_to_end = t_end - t_start
+    http = [s for s in spans if s.get("name") == "http.solve"]
+    out = {
+        "end_to_end_ms": round(end_to_end * 1e3, 6),
+        "phases_ms": {p: round(v * 1e3, 6) for p, v in phases.items()},
+        "shares": {
+            p: round(v / end_to_end, 6) for p, v in phases.items()
+        },
+        "spans": len(spans),
+        "nodes": sorted({s.get("node", "") for s in spans}),
+        "t0": t_start,
+        "t1": t_end,
+    }
+    if http:
+        out["http_ms"] = round(
+            (float(http[-1]["t1"]) - float(http[-1]["t0"])) * 1e3, 6
+        )
+    return out
+
+
+class CritPathMonitor:
+    """Aggregating monitor + slow-job watchdog over per-job decompositions.
+
+    ``slow_ms`` pins the watchdog threshold explicitly; None derives it
+    from the installed SLO plane (the smallest latency objective's
+    threshold — a job breaching its objective is by definition slow).
+    With neither, the watchdog is off and only aggregation runs.
+    ``dump_cooldown_s`` bounds dump volume under a slow-job storm.
+    Clock-injectable like every obs plane.
+    """
+
+    def __init__(
+        self,
+        slow_ms: Optional[float] = None,
+        dump_cooldown_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.slow_ms = slow_ms
+        self.dump_cooldown_s = float(dump_cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.hist = {
+            f"critpath_{p}_ms": LatencyHistogram() for p in ALL_PHASES
+        }
+        self.attribution_ms = {p: 0.0 for p in ALL_PHASES}
+        self.jobs = 0
+        self.slow_jobs = 0
+        self.slow_dumps = 0
+        self._last_dump: Optional[float] = None
+
+    def threshold_ms(self) -> Optional[float]:
+        if self.slow_ms is not None:
+            return float(self.slow_ms)
+        mon = slo_mod.active()
+        if mon is None:
+            return None
+        lat = [o.threshold for o in mon.objectives if o.kind == "latency"]
+        return min(lat) if lat else None
+
+    def observe_job(self, uuid: str, wall_s: float) -> None:
+        """One resolved job: decompose its stitched spans, aggregate, and
+        run the watchdog.  No recorder installed -> no spans -> no-op
+        (the monitor is only reachable from inside the engine's traced
+        branch anyway).  Never raises into the device loop."""
+        rec = trace.active()
+        if rec is None:
+            return
+        try:
+            d = decompose(rec.spans(uuid))
+        except Exception:  # noqa: BLE001 - evidence, not a dependency
+            _LOG.exception("[critpath] decomposition failed for %s", uuid)
+            return
+        if d is None:
+            return
+        with self._lock:
+            self.jobs += 1
+            for p in ALL_PHASES:
+                ms = d["phases_ms"][p]
+                self.attribution_ms[p] += ms
+                if ms > 0:
+                    self.hist[f"critpath_{p}_ms"].record(ms / 1e3)
+        thr = self.threshold_ms()
+        if thr is None or wall_s * 1e3 <= thr:
+            return
+        with self._lock:
+            self.slow_jobs += 1
+            now = self._clock()
+            fire = (
+                self._last_dump is None
+                or now - self._last_dump >= self.dump_cooldown_s
+            )
+            if fire:
+                self._last_dump = now
+                self.slow_dumps += 1
+        top = max(
+            ((p, d["phases_ms"][p]) for p in ALL_PHASES), key=lambda kv: kv[1]
+        )
+        job_log(_LOG, uuid).warning(
+            "[critpath] slow job: %.1f ms > %.1f ms threshold — dominant "
+            "phase %s (%.1f ms, %.0f%%)%s",
+            wall_s * 1e3, thr, top[0], top[1],
+            100.0 * d["shares"][top[0]],
+            "" if fire else " (dump suppressed: cooldown)",
+        )
+        if fire:
+            rec.dump("slow_job", metrics={"uuid": uuid, "analysis": d})
+
+    # -- reads ----------------------------------------------------------------
+    def hist_dicts(self) -> dict:
+        """The mergeable per-phase histograms, keyed for the engine's
+        ``hist`` section (cluster rollup vector-adds them for free)."""
+        with self._lock:
+            return {k: h.to_dict() for k, h in self.hist.items() if len(h)}
+
+    def metrics(self) -> dict:
+        with self._lock:
+            total = sum(self.attribution_ms.values())
+            out = {
+                "jobs": int(self.jobs),
+                "attribution_ms": {
+                    p: round(v, 3) for p, v in self.attribution_ms.items()
+                },
+                "slow_jobs": int(self.slow_jobs),
+                "slow_dumps": int(self.slow_dumps),
+            }
+            if total > 0:
+                out["shares_pct"] = {
+                    p: round(100.0 * v / total, 2)
+                    for p, v in self.attribution_ms.items()
+                }
+        thr = self.threshold_ms()
+        if thr is not None:
+            out["threshold_ms"] = thr
+        return out
+
+
+# -- the process-wide seam ----------------------------------------------------
+
+_active: Optional[CritPathMonitor] = None
+
+
+def install(monitor: Optional[CritPathMonitor]) -> None:
+    global _active
+    _active = monitor
+
+
+def active() -> Optional[CritPathMonitor]:
+    return _active
+
+
+@contextlib.contextmanager
+def installed(monitor: CritPathMonitor):
+    """Scope a monitor over a block (tests): always uninstalls."""
+    install(monitor)
+    try:
+        yield monitor
+    finally:
+        install(None)

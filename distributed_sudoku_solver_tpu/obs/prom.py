@@ -47,6 +47,10 @@ _LABEL_DICTS = {
     # ("10.0.0.1:7000") are identities, not name-path material.
     "objectives": "objective",
     "cluster_nodes": "node",
+    # Compile-watch / cost-plane program tables (obs/compilewatch.py):
+    # per-program series label by display name instead of minting one
+    # metric family per compiled program.
+    "programs": "program",
 }
 
 

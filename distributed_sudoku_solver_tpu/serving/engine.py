@@ -54,7 +54,7 @@ import jax
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
-from distributed_sudoku_solver_tpu.obs import slo, trace
+from distributed_sudoku_solver_tpu.obs import compilewatch, critpath, slo, trace
 from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram, MinEstimator
 from distributed_sudoku_solver_tpu.obs.logctx import job_log, uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
@@ -705,8 +705,44 @@ class SolverEngine:
         # histograms (cluster-scope aggregation vector-adds these across
         # members) and the live RPC-floor estimate from chunk.sync walls.
         hist_sec = {k: h.to_dict() for k, h in self.hist.items() if len(h)}
+        cp = critpath.active()
+        if cp is not None:
+            # Per-phase critical-path histograms ride the same ``hist``
+            # keyspace (``critpath_<phase>_ms``), so the cluster rollup
+            # vector-adds them with zero extra aggregation code; the
+            # shares/watchdog counters get their own section below.
+            hist_sec.update(cp.hist_dicts())
+            out["critpath"] = cp.metrics()
         if hist_sec:
             out["hist"] = hist_sec
+        cw = compilewatch.active()
+        if cw is not None:
+            # The compile/recompile watch (obs/compilewatch.py): per-
+            # program compile counts and walls, warmup/alarm state, and
+            # — when the serving loops captured a cost model — the cost
+            # plane with the live device-efficiency gauge (measured
+            # rounds/s priced by the per-round HLO cost analysis).
+            out["compile"] = cw.metrics()
+            cost = cw.cost_metrics()
+            if cost is not None:
+                # Frontier rounds + chunk walls from BOTH serving loops
+                # (the resident scheduler is the default path, and a
+                # resident-only node must still light the gauge).
+                rounds = self._chunk_steps_total
+                wall = self._chunk_wall_total
+                for rf in resident_flights:
+                    rounds += rf.rounds_total
+                    wall += rf.round_wall_total
+                eff = cw.efficiency(
+                    compilewatch.ADVANCE_FUSED_STATUS
+                    if self.config.step_impl == "fused"
+                    else compilewatch.ADVANCE_STATUS,
+                    rounds,
+                    wall,
+                )
+                if eff is not None:
+                    cost["efficiency"] = eff
+                out["cost"] = cost
         floor = self.rpc_floor.to_dict()
         if floor is not None:
             out["rpc_floor_ms"] = floor
@@ -1273,6 +1309,33 @@ class SolverEngine:
                 node=self.trace_node, uuids=live_uuids, chunk=fl.chunks,
                 geometry=f"{fl.geom.n}x{fl.geom.n}",
             )
+        cw = compilewatch.active()
+        if cw is not None and fl.chunks == 1:
+            # The cost-plane seam (obs/compilewatch.py): once per
+            # (program, shape) EVER — the dedupe key bounds the lowering,
+            # and the flight-birth guard bounds even the key construction
+            # to one per flight, never per chunk.  ``.lower()`` re-traces
+            # on the host (aval shapes only — it reads no device buffer,
+            # so the one-sync-per-chunk guard stays green) and prices the
+            # program via HLO cost analysis; no backend compile runs, so
+            # the watch's own compile listener hears nothing.
+            prog = (
+                compilewatch.ADVANCE_FUSED_STATUS
+                if fl.config.step_impl == "fused"
+                else compilewatch.ADVANCE_STATUS
+            )
+            # .shape is host-side metadata (a tuple of ints, no sync).
+            lanes = fl.state.has_top.shape[0]
+            cw.capture_cost(
+                prog,
+                (fl.geom.n, lanes, fl.config.stack_slots, fl.config.step_impl),
+                lambda: _advance.lower(
+                    fl.state, jnp.int32(self.chunk_steps), fl.geom, fl.config
+                ),
+                geometry=f"{fl.geom.n}x{fl.geom.n}",
+                lanes=lanes,
+                chunk_steps=self.chunk_steps,
+            )
         if prev_status is None:
             # Newborn flight: chunk 0 is in the device queue and the loop
             # moves on — the flight is a full dispatch ahead from birth.
@@ -1432,6 +1495,14 @@ class SolverEngine:
                 solved=job.solved, unsat=job.unsat, cancelled=job.cancelled,
                 nodes=job.nodes, error=job.error,
             )
+            # Critical-path attribution (obs/critpath.py): decompose the
+            # job's stitched spans into phase walls and run the slow-job
+            # watchdog.  Inside the traced branch on purpose — untraced
+            # serving pays nothing, and without spans there is nothing to
+            # decompose.  Host-side ring scan only: zero device syncs.
+            cp = critpath.active()
+            if cp is not None:
+                cp.observe_job(job.uuid, wall)
         job.done.set()
 
     # -- control requests (snapshot / shed) ----------------------------------
@@ -1601,6 +1672,9 @@ class SolverEngine:
                     unsat=job.unsat, cancelled=job.cancelled,
                     nodes=job.nodes, error=job.error,
                 )
+                cp = critpath.active()
+                if cp is not None:
+                    cp.observe_job(job.uuid, wall)
             job.done.set()
         self.batch_sizes.record(float(len(group)))
         self.validations += int(nodes[: len(group)].sum())

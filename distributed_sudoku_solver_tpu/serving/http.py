@@ -78,7 +78,11 @@ served here is: ``POST /solve``, ``POST /solve_batch``, ``POST
   Perfetto / chrome://tracing; validated by ``obs/traceck.py``).  404
   unless a recorder is installed (``--trace``).
 * ``GET /trace/<uuid>`` — one job's stitched trace (spans from every
-  cluster node that touched it).
+  cluster node that touched it); ``?analyze=1`` adds the critical-path
+  decomposition (``obs/critpath.py``): per-phase walls (queue /
+  dispatch / sync / event / wire / recovery / other) that sum to the
+  job's end-to-end wall, plus attribution shares.  Unknown uuids and
+  malformed ``?limit``/``?analyze`` values answer structured 4xx JSON.
 * ``GET /metrics?format=prometheus`` — the nested metrics dict flattened
   into Prometheus text exposition (``obs/prom.py``); with
   ``scope=cluster`` the federated form: the merged rollup plus per-node
@@ -557,24 +561,62 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _trace_view(self, path: str, query: dict):
         """``GET /trace`` (recent ring; ``?format=perfetto`` for Chrome-
-        trace JSON) and ``GET /trace/<uuid>`` (one job's stitched spans)."""
+        trace JSON) and ``GET /trace/<uuid>`` (one job's stitched spans;
+        ``?analyze=1`` adds the critical-path decomposition,
+        ``obs/critpath.py``).  Hardened: an unknown uuid is a structured
+        404 and a malformed ``?limit``/``?analyze`` value is a structured
+        400 — never a 500 (API-pinned)."""
         rec = trace.active()
         if rec is None:
             return self._send(
                 404, {"error": "tracing disabled (start the node with --trace)"}
             )
+        raw_analyze = query.get("analyze", ["0"])[0].lower()
+        if raw_analyze in ("1", "true", "yes"):
+            analyze = True
+        elif raw_analyze in ("0", "false", "no", ""):
+            analyze = False
+        else:
+            return self._send(
+                400,
+                {"error": f"analyze must be 0 or 1, got {raw_analyze!r}"},
+            )
+        limit = None
+        if "limit" in query:
+            try:
+                limit = int(query["limit"][0])
+            except ValueError:
+                return self._send(400, {"error": "limit must be an integer"})
+            if limit <= 0:
+                return self._send(
+                    400, {"error": f"limit must be positive, got {limit}"}
+                )
         if path.startswith("/trace/"):
             uuid = path[len("/trace/") :]
             spans = rec.spans(uuid)
-            return self._send(200, {"uuid": uuid, "count": len(spans),
-                                    "spans": spans})
+            if not spans:
+                return self._send(
+                    404, {"error": "unknown trace uuid", "uuid": uuid}
+                )
+            body = {"uuid": uuid, "count": len(spans), "spans": spans}
+            if analyze:
+                from distributed_sudoku_solver_tpu.obs import critpath
+
+                # Decompose over the FULL stitched trace, then apply the
+                # limit to the echoed spans only — a truncated window
+                # would silently break the phases-sum-to-wall contract.
+                body["analysis"] = critpath.decompose(spans)
+                body["analysis_tolerance"] = critpath.SUM_TOLERANCE
+            if limit is not None:
+                body["spans"] = body["spans"][-limit:]
+            return self._send(200, body)
+        if analyze:
+            return self._send(
+                400, {"error": "analyze requires a job: GET /trace/<uuid>?analyze=1"}
+            )
         if query.get("format", [""])[0] == "perfetto":
             return self._send(200, rec.perfetto())
-        try:
-            limit = int(query.get("limit", ["1000"])[0])
-        except ValueError:
-            return self._send(400, {"error": "limit must be an integer"})
-        spans = rec.spans(limit=max(1, limit))
+        spans = rec.spans(limit=limit if limit is not None else 1000)
         return self._send(200, {"count": len(spans), "spans": spans})
 
     @staticmethod

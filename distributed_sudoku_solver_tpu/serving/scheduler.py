@@ -64,7 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
-from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs import compilewatch, trace
 from distributed_sudoku_solver_tpu.obs.logctx import uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import (
     Frontier,
@@ -285,6 +285,15 @@ class ResidentFlight:
         #   the round's SECOND sync (floor included), recorded so the
         #   split never hides it (same property as the engine's
         #   event_wall)
+        # Running frontier-round / wall totals (single-writer: the device
+        # loop) — the resident twin of the engine's _chunk_steps_total /
+        # _chunk_wall_total, so the cost plane's device-efficiency gauge
+        # (obs/compilewatch.py) stays live on a resident-serving node.
+        # Wall here is the per-round sync wall: the dominant host-side
+        # share of a resident round (dispatches are async-thin).
+        self.rounds_total = 0
+        self.round_wall_total = 0.0
+        self._steps_seen = 0
 
     # -- any-thread surface --------------------------------------------------
     #: admit() verdicts.  SATURATED is the only one a reject-mode caller
@@ -453,6 +462,15 @@ class ResidentFlight:
         self.engine.hist["chunk_wall_ms"].record(sync_s)
         self.engine.rpc_floor.record(sync_s)
         self.chunks += 1
+        # Round/wall totals for the device-efficiency gauge.  A negative
+        # delta is the _REBASE_STEPS reset — rebase the baseline, skip
+        # the sample (limits are relative, so nothing is lost).
+        steps = int(self._status["steps"])
+        delta = steps - self._steps_seen
+        self._steps_seen = steps
+        if delta > 0:
+            self.rounds_total += delta
+            self.round_wall_total += sync_s
         # A consumed chunk is the breaker's definition of success: it
         # resets the consecutive-failure count and closes a half-open
         # breaker (the probe rebuild proved the device serves again).
@@ -713,6 +731,34 @@ class ResidentFlight:
                 None, "resident.chunk.dispatch", "resident.advance", tr0,
                 node=self.engine.trace_node,
                 uuids=[j.uuid for j in self.slots if j is not None],
+            )
+        cw = compilewatch.active()
+        if cw is not None and self.chunks == 0:
+            # Cost-plane seam (obs/compilewatch.py), the engine's twin:
+            # once per (program, resident shape) — the chunks==0 guard
+            # bounds even the key construction to the flight's first
+            # round(s), and ``.lower()`` reads aval shapes only (no
+            # device sync; the fetch-count guard runs with the watch
+            # installed to prove it).
+            prog = (
+                compilewatch.ADVANCE_FUSED_STATUS
+                if self.config.step_impl == "fused"
+                else compilewatch.ADVANCE_STATUS
+            )
+            # .shape is host-side metadata (a tuple of ints, no sync).
+            lanes = self.state.has_top.shape[0]
+            cw.capture_cost(
+                prog,
+                (self.geom.n, lanes, self.config.stack_slots,
+                 self.config.step_impl, "resident"),
+                lambda: _advance_fn.lower(
+                    self.state, jnp.int32(self.rcfg.chunk_steps),
+                    self.geom, self.config,
+                ),
+                geometry=f"{self.geom.n}x{self.geom.n}",
+                lanes=lanes,
+                chunk_steps=self.rcfg.chunk_steps,
+                resident=True,
             )
 
     def on_failure(self, exc: BaseException) -> None:

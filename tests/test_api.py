@@ -284,6 +284,54 @@ def test_trace_endpoints(server):
     assert status == 404  # uninstalled again
 
 
+def test_trace_analyze_and_hardening(server):
+    """Round-15 satellite + acceptance: ``GET /trace/<uuid>?analyze=1``
+    serves the critical-path decomposition whose phase walls sum to the
+    job's end-to-end wall within the documented tolerance (the
+    real-clock half of the contract — the virtual-clock half lives in
+    tests/test_critpath.py); unknown uuids and malformed
+    ``?limit``/``?analyze`` values are structured 4xx, never a 500."""
+    from distributed_sudoku_solver_tpu.obs import critpath, trace
+
+    rec = trace.TraceRecorder(ring=4096)
+    with trace.installed(rec):
+        status, _ = _request(
+            server, "/solve", {"sudoku": np.asarray(EASY_9).tolist()}
+        )
+        assert status == 201
+        uuid = next(
+            s["trace"] for s in reversed(rec.spans())
+            if s["name"] == "http.solve"
+        )
+
+        status, body = _request(server, f"/trace/{uuid}?analyze=1")
+        assert status == 200
+        d = body["analysis"]
+        assert body["analysis_tolerance"] == critpath.SUM_TOLERANCE
+        total = sum(d["phases_ms"].values())
+        assert abs(total - d["end_to_end_ms"]) <= (
+            d["end_to_end_ms"] * critpath.SUM_TOLERANCE
+        ), (total, d["end_to_end_ms"])
+        assert d["phases_ms"]["sync"] >= 0 and "shares" in d
+        # analyze + limit compose: the decomposition covers the FULL
+        # trace even when the echoed spans are truncated.
+        status, body = _request(server, f"/trace/{uuid}?analyze=1&limit=1")
+        assert status == 200 and len(body["spans"]) == 1
+        assert body["analysis"]["end_to_end_ms"] == d["end_to_end_ms"]
+
+        # Hardening: structured 4xx on every malformed input.
+        status, body = _request(server, "/trace/no-such-uuid")
+        assert status == 404 and body["error"] == "unknown trace uuid"
+        status, body = _request(server, f"/trace/{uuid}?analyze=2")
+        assert status == 400 and "analyze" in body["error"]
+        status, body = _request(server, f"/trace/{uuid}?limit=0")
+        assert status == 400 and "limit" in body["error"]
+        status, body = _request(server, "/trace?limit=-5")
+        assert status == 400
+        status, body = _request(server, "/trace?analyze=1")
+        assert status == 400 and "uuid" in body["error"]
+
+
 def test_metrics_prometheus_exposition(server):
     import urllib.request as _rq
 

@@ -585,6 +585,45 @@ def test_regress_gate_exit_codes(tmp_path):
     assert any("p95" in r for r in rep["regressions"])
 
 
+def test_regress_labels_cold_cache_runs(tmp_path, capsys):
+    """Round-15 satellite: an artifact whose `compile` section says the
+    run paid XLA compiles inside its measured window is LABELED in the
+    report (and a cold-vs-warm compare earns a re-run note) instead of
+    hiding compile noise inside the tolerance band.  Artifacts without
+    the section stay label-free and comparable."""
+    regress = _load_regress()
+
+    def write(name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    warm = _artifact(100.0, 400.0)
+    warm["compile"] = {"programs": {}, "compiles_total": 0,
+                       "wall_ms_total": 0.0, "cache": {}, "cold": False}
+    cold = _artifact(100.0, 400.0)
+    cold["compile"] = {
+        "programs": {"advance_status": {"count": 1, "wall_ms_total": 1800.0}},
+        "compiles_total": 6, "wall_ms_total": 5400.0, "cache": {},
+        "cold": True,
+    }
+    base = write("base.json", warm)
+    cold_p = write("cold.json", cold)
+    assert regress.main([base, cold_p]) == 0  # labeled, still gated
+    out = capsys.readouterr().out
+    assert "COLD-CACHE" in out and "compile noise" in out
+    assert "re-run the candidate warm" in out
+    # Cold old vs warm new: the improvement-direction caveat.
+    assert regress.main([cold_p, base]) == 0
+    assert "re-run the baseline warm" in capsys.readouterr().out
+    # compare() exposes the same labels programmatically.
+    rep = regress.compare(warm, cold)
+    assert any("COLD-CACHE" in n for n in rep["notes"])
+    # Pre-round-15 artifacts (no compile section) stay label-free.
+    rep = regress.compare(_artifact(100.0, 400.0), _artifact(100.0, 400.0))
+    assert rep["notes"] == []
+
+
 def test_bench_artifact_schema_matches_regress_expectations():
     """The artifact bench_poisson --out-json writes and the gate's schema
     constant must not drift apart (they live in different files)."""
@@ -744,6 +783,83 @@ def test_partitioned_member_flagged_unreachable_without_blocking(caplog):
         cm2 = a.cluster_metrics_view()
         assert cm2["nodes"][b.addr_s]["stale"] is True
         assert cm2["nodes"][b.addr_s]["metrics"] is not None  # still merged
+    finally:
+        for n in nodes:
+            n.kill()
+        for e in engines:
+            e.stop(timeout=1)
+        net.close()
+
+
+@pytest.mark.simnet
+def test_cluster_scope_merge_federates_compile_and_critpath(tmp_path):
+    """Round-15 satellite: the cluster rollup federates the new planes —
+    per-program compile counts/walls sum across members (wall histograms
+    vector-add), critpath attribution totals sum with shares re-derived
+    from the merged totals, and the per-phase ``critpath_*_ms``
+    histograms merge through the existing ``hist`` rule.  In the
+    single-process simnet lane all three nodes share the process-wide
+    watch/monitor, so every per-node body reports the same numbers and
+    the rollup must read exactly 3x each — the vector-sum semantics the
+    federation promises."""
+    from distributed_sudoku_solver_tpu.cluster.simnet import SimNet
+    from distributed_sudoku_solver_tpu.obs import compilewatch, critpath
+
+    class _FakeProg:
+        n = 0
+
+        def _cache_size(self):
+            return self.n
+
+    net = SimNet()
+    fake = _FakeProg()
+    watch = compilewatch.CompileWatch(
+        programs={"prog_a": fake}, warmup_s=1e9
+    )
+    rec = trace.TraceRecorder(ring=4096, clock=net.clock.now)
+    mon = critpath.CritPathMonitor()
+    engines, nodes = _ring3(net, _cluster_cfg())
+    a = nodes[0]
+    try:
+        with trace.installed(rec), compilewatch.installed(watch), \
+                critpath.installed(mon):
+            # Two compiles of prog_a (real event-before-insert ordering).
+            ev = compilewatch.BACKEND_COMPILE_EVENT
+            watch.on_duration(ev, 0.5)
+            fake.n += 1
+            watch.on_duration(ev, 0.25)
+            fake.n += 1
+            watch.poll()
+            # One decomposed job feeding the critpath plane.
+            rec.record("u1", "admission", "engine.launch", 0.0, t1=0.1)
+            rec.record(None, "chunk.sync", "fetch.status", 0.1, t1=0.4,
+                       uuids=["u1"])
+            rec.record("u1", "resolve", "engine.resolve", 0.4, t1=0.4)
+            mon.observe_job("u1", 0.4)
+
+            cm = a.cluster_metrics_view()
+            per_node = [n["metrics"] for n in cm["nodes"].values()]
+            assert len(per_node) == 3
+            # Every member exported the shared sections identically...
+            for body in per_node:
+                assert body["compile"]["programs"]["prog_a"]["count"] == 2
+                assert body["critpath"]["jobs"] == 1
+            # ...and the rollup is their sum, program by program and
+            # phase by phase.
+            ru = cm["rollup"]
+            prog = ru["compile"]["programs"]["prog_a"]
+            assert prog["count"] == 6
+            assert prog["wall_ms_total"] == pytest.approx(3 * 750.0)
+            assert sum(prog["wall_ms"]["counts"]) == 6
+            assert ru["compile"]["compiles_total"] == 6
+            cp = ru["critpath"]
+            assert cp["jobs"] == 3
+            assert cp["attribution_ms"]["sync"] == pytest.approx(900.0)
+            assert cp["attribution_ms"]["queue"] == pytest.approx(300.0)
+            # Shares re-derived from the MERGED totals, not averaged.
+            assert cp["shares_pct"]["sync"] == pytest.approx(75.0)
+            # The per-phase hists rode the hist rule: 3x vector add.
+            assert hist.hist_count(ru["hist"]["critpath_sync_ms"]) == 3
     finally:
         for n in nodes:
             n.kill()

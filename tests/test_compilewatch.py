@@ -1,0 +1,336 @@
+"""Round-15 compile/recompile watch (obs/compilewatch.py).
+
+Three layers:
+
+* **Unit lane** — fake program registries on a fake clock: attribution
+  pairs event durations with cache growth (counts exact, unregistered
+  compiles bucketed), warmup gates the alarm, the recompile dump is
+  edge-triggered (one per excursion) and re-arms after a quiet period.
+* **Live lane** — a real engine under the watch: the serving workload's
+  compiles land under display names on ``/metrics`` (the cost plane and
+  efficiency gauge ride along), and a deliberately forced program change
+  after warmup fires EXACTLY one ``recompile`` flight-recorder dump,
+  re-armed after recovery (the ISSUE-12 acceptance pin).  The
+  one-compile-per-program half of the acceptance lives in test_jaxck's
+  retrace guard, which now runs ON this seam.
+* **Microcheck** — with nothing installed, the watch's surfaces are
+  provably unreachable (exploding monkeypatches) and a solve still
+  works: the disabled path is one global read + one branch.
+"""
+
+import json
+import logging
+import os
+
+import pytest
+
+from distributed_sudoku_solver_tpu.obs import compilewatch, critpath, trace
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+SMALL = SolverConfig(min_lanes=8, stack_slots=16)
+EV = compilewatch.BACKEND_COMPILE_EVENT
+
+
+@pytest.fixture(autouse=True)
+def _clean_seams():
+    yield
+    compilewatch.install(None)
+    critpath.install(None)
+    trace.install(None)
+
+
+class _FakeProg:
+    """Quacks like a jit function for the attribution poll."""
+
+    def __init__(self, n=0):
+        self.n = n
+
+    def _cache_size(self):
+        return self.n
+
+
+# -- unit lane -----------------------------------------------------------------
+
+
+def test_attribution_counts_walls_and_unregistered():
+    t = [0.0]
+    a, b = _FakeProg(), _FakeProg()
+    w = compilewatch.CompileWatch(
+        programs={"prog_a": a, "prog_b": b}, warmup_s=100.0,
+        clock=lambda: t[0],
+    )
+    # Real ordering: the event for compile N fires BEFORE N's cache
+    # insertion.  a compiles (event, then insert), then b twice.
+    w.on_duration(EV, 0.5)
+    a.n += 1
+    w.on_duration(EV, 0.25)
+    b.n += 1
+    w.on_duration(EV, 0.125)
+    b.n += 1
+    m = w.metrics()  # the read polls outstanding attribution
+    assert m["programs"]["prog_a"]["count"] == 1
+    assert m["programs"]["prog_a"]["wall_ms_total"] == pytest.approx(500.0)
+    assert m["programs"]["prog_b"]["count"] == 2
+    assert m["programs"]["prog_b"]["wall_ms_total"] == pytest.approx(375.0)
+    assert m["compiles_total"] == 3
+    assert m["recompiles_total"] == 0  # all inside warmup
+    # A compile no registered program accounts for -> unregistered, but
+    # only after SURVIVING one attribution pass (the first read could be
+    # racing a registered compile's cache insertion — see the race
+    # regression below).
+    w.on_duration(EV, 0.0625)
+    m = w.metrics()
+    assert compilewatch.UNREGISTERED not in m["programs"]
+    m = w.metrics()
+    assert m["programs"][compilewatch.UNREGISTERED]["count"] == 1
+    # Unrelated duration events are ignored; cache events counted.
+    w.on_duration("/jax/core/compile/jaxpr_trace_duration", 9.0)
+    w.on_event("/jax/compilation_cache/cache_hits")
+    m = w.metrics()
+    assert m["compiles_total"] == 4
+    assert m["cache"]["persistent_cache_hits"] == 1
+
+
+def test_scrape_racing_cache_insertion_never_misattributes(tmp_path):
+    """Review-round regression: the backend-compile event fires BEFORE
+    the program's cache insertion.  A /metrics scrape landing in that
+    window must neither bucket the compile as `unregistered` nor fire a
+    phantom post-warmup recompile alarm — the pending pairs with the
+    growth at the next pass, counts stay exact."""
+    t = [0.0]
+    rec = trace.TraceRecorder(clock=lambda: t[0], dump_dir=str(tmp_path))
+    a = _FakeProg()
+    w = compilewatch.CompileWatch(
+        programs={"prog_a": a}, warmup_s=0.0, clock=lambda: t[0]
+    )
+    with trace.installed(rec):
+        t[0] = 1.0  # warmup over: a misattribution would ALARM here
+        w.on_duration(EV, 0.5)  # event fired, insertion not yet visible
+        m = w.metrics()  # the racing scrape
+        assert compilewatch.UNREGISTERED not in m["programs"], m
+        a.n += 1  # the insertion lands
+        m = w.metrics()
+        assert m["programs"]["prog_a"]["count"] == 1
+        assert m["programs"]["prog_a"]["wall_ms_total"] == pytest.approx(500.0)
+        assert compilewatch.UNREGISTERED not in m["programs"]
+        assert m["compiles_total"] == 1
+        # The (real) recompile alarmed for prog_a, not a phantom twin.
+        assert m["programs"]["prog_a"].get("recompiles") == 1
+        dumps = [f for f in os.listdir(tmp_path) if "recompile" in f]
+        assert len(dumps) == 1
+
+
+def test_efficiency_suppressed_on_mixed_shapes():
+    """Review-round regression: lifetime round totals span every flight
+    shape, so once two shapes of the advance program captured cost the
+    gauge must refuse to price them with one shape's flops."""
+    w = compilewatch.CompileWatch(programs={}, warmup_s=1e9)
+
+    class _Lowered:
+        def cost_analysis(self):
+            return {"flops": 100.0, "bytes accessed": 10.0}
+
+    name = compilewatch.ADVANCE_STATUS
+    w.capture_cost(name, (9, 128), _Lowered, geometry="9x9")
+    eff = w.efficiency(name, rounds=1000, wall_s=1.0)
+    assert eff["achieved_gflops_per_s"] > 0
+    w.capture_cost(name, (16, 256), _Lowered, geometry="16x16")
+    eff = w.efficiency(name, rounds=1000, wall_s=1.0)
+    assert eff == {
+        "program": "advance_status",
+        "suppressed": "mixed_shapes",
+        "shapes_captured": 2,
+    }
+
+
+def test_warmup_edge_triggered_dump_and_rearm(tmp_path, caplog):
+    t = [0.0]
+    rec = trace.TraceRecorder(clock=lambda: t[0], dump_dir=str(tmp_path))
+    a = _FakeProg()
+    w = compilewatch.CompileWatch(
+        programs={"prog_a": a}, warmup_s=10.0, rearm_s=60.0,
+        clock=lambda: t[0],
+    )
+    with trace.installed(rec):
+        # Inside warmup: expected, no alarm.
+        w.on_duration(EV, 0.1)
+        a.n += 1
+        w.poll()
+        assert w.metrics()["recompiles_total"] == 0
+
+        # After warmup: first unexpected recompile -> log + ONE dump.
+        t[0] = 20.0
+        with caplog.at_level(logging.WARNING):
+            w.on_duration(EV, 0.2)
+            a.n += 1
+            w.poll()
+        assert any(
+            "[compile prog_a]" in r.getMessage() for r in caplog.records
+        ), "recompile alarm must log [compile <program>]"
+        m = w.metrics()
+        assert m["recompiles_total"] == 1
+        assert m["programs"]["prog_a"]["recompiles"] == 1
+        assert m["dumps"] == 1 and m["armed"] is False
+        dumps = [f for f in os.listdir(tmp_path) if "recompile" in f]
+        assert len(dumps) == 1
+        doc = json.loads((tmp_path / dumps[0]).read_text())
+        assert doc["metrics"]["program"] == "prog_a"
+
+        # Same excursion (still inside rearm_s): counted, NOT dumped.
+        t[0] = 30.0
+        w.on_duration(EV, 0.2)
+        a.n += 1
+        w.poll()
+        assert w.metrics()["recompiles_total"] == 2
+        assert len([f for f in os.listdir(tmp_path) if "recompile" in f]) == 1
+
+        # Recovery: rearm_s of quiet re-arms; the next excursion dumps.
+        t[0] = 30.0 + 61.0
+        assert w.metrics()["armed"] is True  # reads apply the re-arm edge
+        w.on_duration(EV, 0.2)
+        a.n += 1
+        w.poll()
+        assert len([f for f in os.listdir(tmp_path) if "recompile" in f]) == 2
+        # The alarm also leaves a trace event behind for the timeline.
+        assert any(s["name"] == "compile" for s in rec.spans())
+
+
+def test_seal_ends_warmup_immediately():
+    t = [0.0]
+    a = _FakeProg()
+    w = compilewatch.CompileWatch(
+        programs={"prog_a": a}, warmup_s=1e9, clock=lambda: t[0]
+    )
+    assert not w.warmup_over()
+    w.seal()
+    assert w.warmup_over()
+    w.on_duration(EV, 0.1)
+    a.n += 1
+    assert w.metrics()["recompiles_total"] == 1
+
+
+# -- live lane -----------------------------------------------------------------
+
+
+def test_live_workload_exports_per_program_counts_and_cost(
+    heavy_compile_guard,
+):
+    """A real engine under the watch: per-program compile counts appear
+    under manifest display names in /metrics' `compile` section, and the
+    cost plane captures the advance program's per-round flops with a
+    live efficiency gauge (ceiling ratio when peak_gflops is set)."""
+    watch = compilewatch.CompileWatch(warmup_s=3600.0, peak_gflops=100.0)
+    with compilewatch.installed(watch):
+        eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=4).start()
+        try:
+            j = eng.submit(HARD_9[1])
+            assert j.wait(180) and j.solved, j.error
+            m = eng.metrics()
+        finally:
+            eng.stop(timeout=2)
+    sec = m["compile"]
+    assert sec["registered"] == 21  # every ENTRY_POINTS program resolved
+    assert sec["recompiles_total"] == 0 and sec["armed"] is True
+    # Display names are the manifest's shared vocabulary.  In a crowded
+    # pytest process the serving set may be cache-warm (counts then stay
+    # 0 and the program is absent) — but ANY compile this process paid
+    # here must be attributed, and the status-advance program's cost
+    # model is captured regardless of cache warmth.
+    for name in sec["programs"]:
+        assert name == compilewatch.UNREGISTERED or name in {
+            e.get("display") for e in _manifest_entries()
+        }, name
+    cost = m["cost"]["programs"]["advance_status"]
+    assert cost["flops"] > 0 and cost["bytes_accessed"] > 0
+    assert cost["geometry"] == "9x9"
+    eff = m["cost"]["efficiency"]
+    assert eff["program"] == "advance_status"
+    assert eff["achieved_gflops_per_s"] > 0
+    assert eff["peak_gflops"] == 100.0
+    assert 0 < eff["device_efficiency"] < 1
+
+
+def _manifest_entries():
+    from distributed_sudoku_solver_tpu.analysis import manifest
+
+    return manifest.ENTRY_POINTS
+
+
+def test_forced_program_change_fires_exactly_one_recompile_dump(
+    tmp_path,
+):
+    """The ISSUE-12 acceptance: after warmup, a deliberately forced
+    program change (a fresh static config — exactly what an HLO change
+    does to the XLA cache) fires EXACTLY one recompile flight-recorder
+    dump for the whole storm, and the alarm re-arms after recovery."""
+    t = [0.0]
+    rec = trace.TraceRecorder(dump_dir=str(tmp_path))
+    watch = compilewatch.CompileWatch(
+        warmup_s=100.0, rearm_s=60.0, clock=lambda: t[0]
+    )
+    with trace.installed(rec), compilewatch.installed(watch):
+        eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=8).start()
+        try:
+            # Warmup: the serving set compiles (or is cache-warm).
+            j = eng.submit(EASY_9)
+            assert j.wait(180) and j.solved, j.error
+            assert watch.metrics()["recompiles_total"] == 0
+
+            # Warmup over; force a program change: a private static
+            # config nothing else in the suite uses recompiles the
+            # whole flight set — MANY recompile events, ONE dump.
+            t[0] = 200.0
+            j = eng.submit(
+                EASY_9, config=SolverConfig(min_lanes=8, stack_slots=19)
+            )
+            assert j.wait(240) and j.solved, j.error
+            m = watch.metrics()
+            assert m["recompiles_total"] >= 2, m
+            dumps = [f for f in os.listdir(tmp_path) if "recompile" in f]
+            assert len(dumps) == 1, dumps
+            assert m["armed"] is False
+
+            # Recovery (a quiet rearm_s), then a second forced change:
+            # the re-armed alarm dumps exactly once more.
+            t[0] = 200.0 + 61.0
+            assert watch.metrics()["armed"] is True
+            j = eng.submit(
+                EASY_9, config=SolverConfig(min_lanes=8, stack_slots=21)
+            )
+            assert j.wait(240) and j.solved, j.error
+            assert watch.metrics()["recompiles_total"] >= 4
+            dumps = [f for f in os.listdir(tmp_path) if "recompile" in f]
+            assert len(dumps) == 2, dumps
+        finally:
+            eng.stop(timeout=2)
+
+
+# -- microcheck ----------------------------------------------------------------
+
+
+def test_disabled_seams_are_one_global_read(monkeypatch):
+    """With no watch/monitor installed, none of the new surfaces may be
+    reached from a serving solve: the guard is `active() is None` and
+    everything else lives behind it."""
+    assert compilewatch.active() is None
+    assert critpath.active() is None
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("observability surface reached while disabled")
+
+    monkeypatch.setattr(compilewatch.CompileWatch, "on_duration", boom)
+    monkeypatch.setattr(compilewatch.CompileWatch, "on_event", boom)
+    monkeypatch.setattr(compilewatch.CompileWatch, "capture_cost", boom)
+    monkeypatch.setattr(compilewatch.CompileWatch, "metrics", boom)
+    monkeypatch.setattr(critpath.CritPathMonitor, "observe_job", boom)
+    monkeypatch.setattr(critpath.CritPathMonitor, "metrics", boom)
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=4).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(180) and j.solved, j.error
+        m = eng.metrics()
+        assert "compile" not in m and "cost" not in m and "critpath" not in m
+    finally:
+        eng.stop(timeout=2)

@@ -340,6 +340,73 @@ PROM_SAMPLE = {
             },
         },
     },
+    # Round-15 sections: the compile watch (per-program counts/walls as
+    # a `program`-labeled table + alarm state), the cost plane (per-
+    # program flops/bytes + the efficiency gauge), and critical-path
+    # attribution (per-phase totals/shares; its histograms ride `hist`).
+    "compile": {
+        "programs": {
+            "advance_status": {
+                "count": 1,
+                "wall_ms_total": 1812.4,
+                "wall_ms": {
+                    "type": "log2_hist",
+                    "edge0_ms": 0.001,
+                    "counts": [0] * 21 + [1] + [0] * 10,
+                    "sum_ms": 1812.4,
+                },
+            },
+            "unregistered": {"count": 3, "wall_ms_total": 40.25},
+        },
+        "registered": 21,
+        "compiles_total": 4,
+        "recompiles_total": 0,
+        "warmup_over": True,
+        "armed": True,
+        "dumps": 0,
+        "cache": {"persistent_cache_hits": 2, "persistent_cache_misses": 1},
+    },
+    "cost": {
+        "programs": {
+            "advance_status": {
+                "flops": 60774.0,
+                "bytes_accessed": 1147547.0,
+                "geometry": "9x9",
+                "lanes": 8,
+                "chunk_steps": 64,
+            },
+        },
+        "efficiency": {
+            "program": "advance_status",
+            "flops_per_round": 60774.0,
+            "achieved_rounds_per_s": 771.996,
+            "achieved_gflops_per_s": 0.046917,
+        },
+    },
+    "critpath": {
+        "jobs": 12,
+        "attribution_ms": {
+            "sync": 820.5,
+            "event": 14.0,
+            "dispatch": 95.25,
+            "wire": 0.0,
+            "recovery": 0.0,
+            "queue": 310.0,
+            "other": 60.25,
+        },
+        "shares_pct": {
+            "sync": 63.15,
+            "event": 1.08,
+            "dispatch": 7.33,
+            "wire": 0.0,
+            "recovery": 0.0,
+            "queue": 23.86,
+            "other": 4.64,
+        },
+        "slow_jobs": 1,
+        "slow_dumps": 1,
+        "threshold_ms": 250.0,
+    },
 }
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "data", "prometheus_golden.txt")
@@ -373,28 +440,34 @@ def test_prometheus_sample_passes_promck():
 
 def test_promck_over_live_prometheus_endpoint():
     """Satellite: the LIVE ``GET /metrics?format=prometheus`` body — with
-    the histogram sections populated by a real solve — passes promck."""
+    the histogram sections populated by a real solve and the round-15
+    compile/cost/critpath planes installed — passes promck."""
     import urllib.request
 
-    from distributed_sudoku_solver_tpu.obs import promck
+    from distributed_sudoku_solver_tpu.obs import compilewatch, critpath, promck
     from distributed_sudoku_solver_tpu.serving.http import (
         ApiServer,
         StandaloneNode,
     )
 
+    rec = trace.TraceRecorder(ring=4096)
+    watch = compilewatch.CompileWatch(warmup_s=3600.0)
+    mon = critpath.CritPathMonitor()
     eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=4).start()
     api = ApiServer(StandaloneNode(eng), host="127.0.0.1", port=0).start()
     try:
-        j = eng.submit(HARD_9[1])
-        assert j.wait(120) and j.solved, j.error
-        raw = (
-            urllib.request.urlopen(
-                f"http://127.0.0.1:{api.port}/metrics?format=prometheus",
-                timeout=30,
+        with trace.installed(rec), compilewatch.installed(watch), \
+                critpath.installed(mon):
+            j = eng.submit(HARD_9[1])
+            assert j.wait(120) and j.solved, j.error
+            raw = (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{api.port}/metrics?format=prometheus",
+                    timeout=30,
+                )
+                .read()
+                .decode()
             )
-            .read()
-            .decode()
-        )
     finally:
         api.stop()
         eng.stop(timeout=2)
@@ -403,6 +476,15 @@ def test_promck_over_live_prometheus_endpoint():
     assert 'dsst_hist_latency_ms_bucket{le="+Inf"}' in raw
     assert "dsst_hist_latency_ms_count" in raw
     assert "dsst_rpc_floor_ms_min" in raw
+    # Round-15 families render and lint: compile counts label by
+    # program, the cost plane's efficiency gauge is live, and the
+    # critical-path histograms joined the mergeable hist keyspace.
+    assert "dsst_compile_compiles_total" in raw
+    assert "dsst_compile_registered 21" in raw
+    assert 'dsst_cost_programs_flops{program="advance_status"}' in raw
+    assert "dsst_cost_efficiency_achieved_gflops_per_s" in raw
+    assert "dsst_critpath_jobs" in raw
+    assert 'dsst_hist_critpath_sync_ms_bucket{le="+Inf"}' in raw
 
 
 # -- simnet acceptance ---------------------------------------------------------

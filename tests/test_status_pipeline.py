@@ -284,19 +284,39 @@ def counted_fetches(monkeypatch):
     return calls
 
 
-@pytest.fixture(params=[False, True], ids=["untraced", "traced"])
+@pytest.fixture(
+    params=["untraced", "traced", "watched"],
+    ids=["untraced", "traced", "watched"],
+)
 def tracing(request):
-    """Run the sync-count guards both ways: the round-11 trace plane
+    """Run the sync-count guards three ways: the round-11 trace plane
     (obs/trace.py) promises ZERO host syncs — every span is built from
     values the loop already holds — so the one-sync-per-chunk contract
-    must hold bit-identically with a recorder installed."""
-    if not request.param:
+    must hold bit-identically with a recorder installed; and the
+    round-15 compile watch + critical-path monitor (obs/compilewatch.py,
+    obs/critpath.py) make the same promise — attribution polls jit-cache
+    sizes and the cost seam lowers on the host, so the ``watched``
+    variant (all three planes installed) must count identically too
+    (the ISSUE-12 zero-added-syncs acceptance)."""
+    if request.param == "untraced":
         yield None
         return
     from distributed_sudoku_solver_tpu.obs import trace
 
     rec = trace.TraceRecorder(ring=8192)
     trace.install(rec)
+    if request.param == "watched":
+        from distributed_sudoku_solver_tpu.obs import compilewatch, critpath
+
+        compilewatch.install(compilewatch.CompileWatch(warmup_s=3600.0))
+        critpath.install(critpath.CritPathMonitor())
+        try:
+            yield rec
+        finally:
+            critpath.install(None)
+            compilewatch.install(None)
+            trace.install(None)
+        return
     try:
         yield rec
     finally:
