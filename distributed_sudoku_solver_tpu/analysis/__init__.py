@@ -3,7 +3,7 @@
 ``obs/traceck.py`` and ``obs/promck.py`` lint the system's *output*
 (trace JSON, Prometheus exposition); this package is the same discipline
 aimed at the *source* — and, since round 14, at what XLA *compiles from*
-it.  Four AST-based fast rules plus one opt-in compiled-layer rule
+it.  Five AST-based fast rules plus one opt-in compiled-layer rule
 behind one runner::
 
     python -m distributed_sudoku_solver_tpu.analysis [--json] [--rule R]
@@ -30,6 +30,17 @@ behind one runner::
   (a small dataflow pass over ``host_fetch``/``unpack_status`` results).
 * **lockck** — attributes declared ``# lockck: guard(_lock)`` are only
   written under ``with <base>._lock:`` (or in ``*_locked`` helpers).
+* **deadck** — the thread plane (round 16): every lock is created
+  through ``obs.lockdep.named_*`` with a ``# lockck: name(<tier>.<name>)``
+  identity; the whole-tree lock-acquisition graph (cross-module edges
+  and the ``*_locked`` convention included) must be rank-upward in
+  ``manifest.LOCK_RANKS`` or declared in ``manifest.LOCK_EDGE_DECLARED``;
+  cycles are findings; and a guard-inference pass over
+  ``manifest.DEADCK_THREAD_ROOTS`` reports any ``self.<attr>`` write
+  reachable from >= 2 thread roots with no lock held and no lockck
+  guard — lockck's coverage, proven complete.  The runtime twin
+  (``obs/lockdep.py``) witnesses the same hierarchy live across tier-1;
+  ``tests/test_deadck.py`` cross-checks observed ⊆ predicted.
 * **jaxck** (opt-in: the ONE rule that imports jax, lazily) — abstractly
   traces every ``manifest.ENTRY_POINTS`` jit program at canonical tiny
   shapes and proves the compiled layer: donation lowers to real

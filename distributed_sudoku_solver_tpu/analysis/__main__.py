@@ -37,7 +37,13 @@ import traceback
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from distributed_sudoku_solver_tpu.analysis import clockck, layerck, lockck, syncck
+from distributed_sudoku_solver_tpu.analysis import (
+    clockck,
+    deadck,
+    layerck,
+    lockck,
+    syncck,
+)
 from distributed_sudoku_solver_tpu.analysis import manifest
 from distributed_sudoku_solver_tpu.analysis.common import (
     ALL_RULES,
@@ -98,6 +104,16 @@ def run(
             ))
     if "lockck" in rules:
         findings.extend(lockck.check_modules(mods))
+    deadck_summary = None
+    if "deadck" in rules:
+        dk_findings, deadck_summary = deadck.check_modules(
+            mods,
+            ranks=manifest.LOCK_RANKS,
+            declared=manifest.LOCK_EDGE_DECLARED,
+            base_classes=manifest.DEADCK_BASE_CLASSES,
+            thread_roots=manifest.DEADCK_THREAD_ROOTS,
+        )
+        findings.extend(dk_findings)
     jaxck_summary = None
     if "jaxck" in rules:
         # The lazy lane: this import chain touches jax only inside
@@ -132,6 +148,10 @@ def run(
             for path, line, rule, reason in stale_waivers(mods, rules)
         ],
     }
+    if deadck_summary is not None:
+        # The predicted thread-plane graph: tier-1's runtime witness
+        # (obs/lockdep.py) must observe a SUBSET of these edges.
+        report["deadck"] = deadck_summary
     if jaxck_summary is not None:
         report["jaxck"] = {
             "drifted": jaxck_summary["drifted"],
@@ -145,8 +165,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m distributed_sudoku_solver_tpu.analysis",
         description=(
-            "invariant linter: layerck/clockck/syncck/lockck (fast, no "
-            "jax) + the opt-in compiled-layer lane (--rule jaxck)"
+            "invariant linter: layerck/clockck/syncck/lockck/deadck (fast, "
+            "no jax) + the opt-in compiled-layer lane (--rule jaxck)"
         ),
     )
     parser.add_argument("--json", action="store_true", help="machine report")

@@ -18,7 +18,7 @@ from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Tuple
 
 #: The default fast lane: stdlib-``ast`` only, no jax, <5 s.
-RULES = ("layerck", "clockck", "syncck", "lockck")
+RULES = ("layerck", "clockck", "syncck", "lockck", "deadck")
 
 #: Rules that lazily import heavy dependencies and therefore only run
 #: when explicitly selected (``--rule jaxck``): the default lane's
@@ -33,13 +33,21 @@ ALL_RULES = RULES + LAZY_RULES
 #: The reason is REQUIRED: an empty ``allow()`` is itself a violation, so
 #: every committed waiver carries its why.
 WAIVER_RE = re.compile(
-    r"#\s*(layerck|clockck|syncck|lockck|jaxck):\s*allow\(([^)]*)\)"
+    r"#\s*(layerck|clockck|syncck|lockck|deadck|jaxck):\s*allow\(([^)]*)\)"
 )
 
 #: lockck's declaration grammar: ``# lockck: guard(<lock_attr>)`` on the
 #: attribute's initialisation line declares that every other write to the
 #: attribute must hold ``<base>.<lock_attr>``.
 GUARD_RE = re.compile(r"#\s*lockck:\s*guard\((\w+)\)")
+
+#: deadck's lock-identity grammar: ``# lockck: name(<tier>.<name>)`` on a
+#: lock's creation line binds the lock object to its manifest identity
+#: (``manifest.LOCK_RANKS``).  The same string is the literal argument of
+#: the ``obs.lockdep.named_*`` factory on that line — deadck checks the
+#: two agree, so the static graph and the runtime witness can never name
+#: the same lock differently.
+NAME_RE = re.compile(r"#\s*lockck:\s*name\(([\w.]+)\)")
 
 
 @dataclasses.dataclass(frozen=True, order=True)

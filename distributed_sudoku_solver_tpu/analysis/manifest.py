@@ -52,11 +52,28 @@ LAYERS = {
         "allow": ("obs", "analysis.manifest"),
         "third_party": ("jax",),
     },
-    "serving.faults": {"closed": True, "allow": (), "third_party": ()},
+    # ...and a second declared exception: obs.lockdep is the runtime
+    # half of the deadck thread-plane contract (ISSUE 13) — it reads the
+    # pure-data lock hierarchy (LOCK_RANKS / LOCK_EDGE_DECLARED) lazily,
+    # inside install(), exactly the compilewatch pattern.
+    "obs.lockdep": {
+        "closed": True,
+        "allow": ("obs", "analysis.manifest"),
+        "third_party": (),
+    },
+    # faults/simnet stay stdlib-closed EXCEPT the named-lock factories:
+    # obs/lockdep.py is itself stdlib-only at import, so the closed
+    # layers' "no heavy deps" promise is intact — the allowance is how
+    # their locks join the one named hierarchy deadck/lockdep prove.
+    "serving.faults": {
+        "closed": True,
+        "allow": ("obs.lockdep",),
+        "third_party": (),
+    },
     "cluster.wire": {"closed": True, "allow": (), "third_party": ()},
     "cluster.simnet": {
         "closed": True,
-        "allow": ("cluster.wire", "serving.faults"),
+        "allow": ("cluster.wire", "serving.faults", "obs.lockdep"),
         "third_party": (),
     },
     # The checker's own layer: source-only tooling.  stdlib + obs (the
@@ -466,6 +483,168 @@ DISPLAY_BY_NAME = {e["name"]: entry_display(e) for e in ENTRY_POINTS}
 # host round-trip syncck cannot see (it fires at run time, inside the
 # compiled program).  ``debug.print`` lowers to debug_callback.
 JAXCK_BANNED_CALLBACKS = ("pure_callback", "io_callback", "debug_callback")
+
+# -- deadck --------------------------------------------------------------
+#
+# The thread-plane manifest: every lock in the repo, named and ranked.
+# ``analysis/deadck.py`` (static) builds the whole-tree lock-acquisition
+# graph and checks each edge against this hierarchy; ``obs/lockdep.py``
+# (runtime) wraps the same named locks and raises on a violating or
+# cycle-forming acquisition at the moment it happens.  One contract, two
+# witnesses — the layerck/simnet split.
+#
+# The rule: a lock may be acquired only while every held lock has a
+# STRICTLY SMALLER rank ("acquire rank-upward").  Rank gaps are left on
+# purpose so a new lock slots in without renumbering.  The ordering
+# encodes the call structure the repo actually has:
+#
+#   cluster.node (10) < cluster.exec (16)
+#       the node's RLock is the outermost coordinator state; it calls
+#       into per-job _Exec bookkeeping, engine submits, wire egress.
+#   obs.slo (24) < serving.* (30..40)
+#       obs.slo is deliberately NOT an obs leaf: the burn-dump holds it
+#       across metrics_fn -> engine.metrics (see LOCK_EDGE_DECLARED), so
+#       it must order BEFORE the serving locks — and the reverse nesting
+#       (engine._lock held into slo.observe) is exactly the ABBA
+#       deadlock this rank order makes a violation.
+#   serving.engine (30) < serving.scheduler (34) < serving.breaker (38)
+#       submit admits into resident flights under the engine lock; the
+#       flight consults its circuit breaker under its own.
+#   obs leaves (60..68)
+#       pure sinks: metrics/trace/histogram recording.  Holding an obs
+#       leaf while acquiring ANY serving/cluster lock is a violation by
+#       construction (their ranks are above every coordination lock).
+#   cluster.simnet (72)
+#       the virtual network's condition is the terminal leaf: the
+#       injected SimClock is read/slept-on under nearly every other
+#       lock, and simnet's delivery path calls handlers only OUTSIDE it.
+LOCK_RANKS = {
+    "cluster.node": 10,       # cluster/node.py ClusterNode._lock (RLock)
+    "cluster.exec": 16,       # cluster/node.py _Exec.lock
+    "obs.slo": 24,            # obs/slo.py SloMonitor._lock (RLock)
+    "serving.engine": 30,     # serving/engine.py SolverEngine._lock
+    "serving.scheduler": 34,  # serving/scheduler.py ResidentFlight._lock
+    "serving.breaker": 38,    # serving/faults.py CircuitBreaker._lock
+    "serving.injector": 40,   # serving/faults.py FaultInjector._lock
+    "serving.control": 42,    # serving/engine.py _Control.lock (dataclass field)
+    "cluster.dedupe": 44,     # cluster/node.py _DedupeLRU._lock
+    "native.build": 50,       # native/__init__.py _lock (libcsp build)
+    "utils.profile_window": 52,  # utils/profiling.py _window_lock
+    "obs.compilewatch": 60,   # obs/compilewatch.py CompileWatch._lock
+    "obs.critpath": 62,       # obs/critpath.py CritPathMonitor._lock
+    "obs.trace": 64,          # obs/trace.py TraceRecorder._lock
+    "obs.hist": 66,           # obs/hist.py LatencyHistogram._lock
+    "obs.minest": 68,         # obs/hist.py MinEstimator._lock
+    "utils.statwindow": 69,   # utils/profiling.py StatWindow._lock (pure leaf)
+    "cluster.simnet": 72,     # cluster/simnet.py SimNet._cond
+}
+
+# Blessed edges the rank order alone does not express — each carries its
+# why, so the re-entrancy contracts review rounds kept re-deriving by
+# hand are DECLARED, tool-checked facts (ISSUE 13).  deadck unions these
+# into its predicted graph; lockdep allows them at runtime.
+# The slo burn-dump re-entrancy (obs/slo.py _dump_locked): the monitor
+# holds its RLock across metrics_fn -> engine.metrics() ->
+# slo.active().metrics(), which re-enters the RLock.  Safe because
+# (a) obs.slo ranks BEFORE serving.engine, so the reverse nesting is a
+# violation, and (b) the engine feeds observe() lock-free (_finish_job
+# runs outside engine._lock).  The whole metrics-snapshot closure is
+# declared — engine.metrics reads every installed plane's lock — because
+# metrics_fn is an injected callable deadck cannot see through: these
+# edges exist only at run time, which is exactly why the runtime witness
+# cross-checks against (static edges UNION this table).  Pinned by
+# tests/test_deadck.py's re-entrancy test, not tribal knowledge.
+_SLO_DUMP_REASON = (
+    "burn-dump evidence capture: SloMonitor._dump_locked holds the "
+    "monitor RLock across metrics_fn -> engine.metrics, which reads "
+    "this plane's lock; the slo read-back re-enters the RLock, and the "
+    "engine never holds its own lock into observe()"
+)
+
+LOCK_EDGE_DECLARED = {
+    ("obs.slo", target): _SLO_DUMP_REASON
+    for target in (
+        "serving.engine",
+        "serving.scheduler",
+        "serving.breaker",
+        "serving.injector",
+        "obs.compilewatch",
+        "obs.critpath",
+        "obs.trace",
+        "obs.hist",
+        "obs.minest",
+        "utils.statwindow",
+    )
+}
+
+# Cross-module receiver hints for deadck's call/lock resolution: the
+# static half cannot type expressions, so the handful of conventional
+# receiver names used across module boundaries are declared here as pure
+# data.  Maps the receiver expression (as written) to the (file, class)
+# whose methods/locks it denotes.
+DEADCK_BASE_CLASSES = {
+    "engine": ("serving/engine.py", "SolverEngine"),
+    "self.engine": ("serving/engine.py", "SolverEngine"),
+    "self.server.engine": ("serving/engine.py", "SolverEngine"),
+    "self.node": ("cluster/node.py", "ClusterNode"),
+    "node": ("cluster/node.py", "ClusterNode"),
+    "ex": ("cluster/node.py", "_Exec"),
+    "rf": ("serving/scheduler.py", "ResidentFlight"),
+    "flight": ("serving/scheduler.py", "ResidentFlight"),
+    "self.breaker": ("serving/faults.py", "CircuitBreaker"),
+    "req": ("serving/engine.py", "_Control"),
+    "self._dedupe": ("cluster/node.py", "_DedupeLRU"),
+    "self._net": ("cluster/simnet.py", "SimNet"),
+    "net": ("cluster/simnet.py", "SimNet"),
+    "mon": ("obs/slo.py", "SloMonitor"),
+    "rec": ("obs/trace.py", "TraceRecorder"),
+    "cw": ("obs/compilewatch.py", "CompileWatch"),
+    "cp": ("obs/critpath.py", "CritPathMonitor"),
+}
+
+# The repo's thread roots: qualname prefixes (per file) whose bodies run
+# on their own threads.  deadck's guard-inference pass walks the call
+# graph from each root; a ``self.<attr>`` write reachable from >= 2
+# distinct roots with no declared lockck guard is a finding — which is
+# what turns lockck's annotate-only coverage into a PROVEN-complete
+# contract (ISSUE 13 tentpole).
+DEADCK_THREAD_ROOTS = {
+    "serving/engine.py": (
+        "SolverEngine._run",      # the device loop
+        "SolverEngine.submit",    # client/handler threads
+        "SolverEngine.cancel",
+    ),
+    "serving/http.py": (
+        "_Handler",               # one thread per HTTP request
+    ),
+    "cluster/node.py": (
+        "ClusterNode._hb_loop",
+        "ClusterNode._progress_loop",
+        "ClusterNode._broadcast_network",
+        "ClusterNode._handle",    # transport connection threads
+        "ClusterNode.submit",     # client threads
+        "_Exec._watch_local",
+    ),
+    "cluster/wire.py": (
+        "TcpTransport._accept_loop",
+        "TcpTransport._serve_conn",
+        "fanout_requests",        # the per-peer ask() threads
+    ),
+    "cluster/simnet.py": (
+        "SimNet._deliver",        # virtual delivery threads
+    ),
+    "serving/portfolio.py": (
+        "race",                   # racer entrant threads (device/native)
+        "race_cover",
+        "race_jobs",
+    ),
+    "utils/profiling.py": (
+        "_close_profile_window",  # the profile-window daemon timer
+    ),
+    "utils/dataset.py": (
+        "solve_file",             # reader/writer pipeline threads
+    ),
+}
 
 # dtypes banned anywhere in a traced program: f64/c128 double both the
 # bytes-per-lane and the cache key space (x64 flips fork every program).

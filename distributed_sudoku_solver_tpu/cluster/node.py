@@ -54,7 +54,7 @@ import numpy as np
 from distributed_sudoku_solver_tpu.cluster import wire
 from distributed_sudoku_solver_tpu.cluster.wire import Addr, WireError, addr_str
 from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
-from distributed_sudoku_solver_tpu.obs import agg, trace
+from distributed_sudoku_solver_tpu.obs import agg, lockdep, trace
 from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
 from distributed_sudoku_solver_tpu.obs.logctx import ctx_log, job_log
 from distributed_sudoku_solver_tpu.serving import faults
@@ -153,7 +153,7 @@ class _DedupeLRU:
     def __init__(self, cap: int = 4096):
         self._cap = cap
         self._seen: collections.OrderedDict = collections.OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("cluster.dedupe")  # lockck: name(cluster.dedupe)
 
     def seen(self, key) -> bool:
         """True if ``key`` was recorded before; records it otherwise."""
@@ -201,7 +201,7 @@ class _Exec:
         #   on_part_result): surfaces as the job's error if it ends unresolved
         self.progress_skip_warned = False  # one degraded-resume warning per job
         self.finalized = False
-        self.lock = threading.Lock()
+        self.lock = lockdep.named_lock("cluster.exec")  # lockck: name(cluster.exec)
         threading.Thread(
             target=self._watch_local, daemon=True, name=f"exec-{self.uuid[:8]}"
         ).start()
@@ -456,7 +456,7 @@ class ClusterNode:
         # trace shows WHICH member ran which chunk.
         engine.trace_node = self.addr_s
 
-        self._lock = threading.RLock()
+        self._lock = lockdep.named_rlock("cluster.node")  # lockck: name(cluster.node)
         self.network: list[str] = [self.addr_s]  # list order defines the ring
         self.coordinator: str = self.addr_s
         # Monotonic membership version, ordered as (term, epoch): the term
@@ -476,8 +476,11 @@ class ClusterNode:
         self._parts: dict[str, str] = {}  # part_uuid -> root uuid (parts run here)
         self._outstanding: dict[str, int] = {}  # member -> in-flight count
         self._rr = 0
-        self.subtasks_sent = 0
-        self.subtasks_run = 0
+        # Shed-part counters: bumped by concurrent NEEDWORK/SUBTASK
+        # handler threads (deadck guard inference caught subtasks_run
+        # outside the lock — a lost-update race since round 10).
+        self.subtasks_sent = 0  # lockck: guard(_lock)
+        self.subtasks_run = 0  # lockck: guard(_lock)
         # PROGRESS snapshots dropped because the frontier was wider than
         # progress_max_rows: the job still completes, but a worker death
         # degrades its resume to root re-execution.  Silent until round 6
@@ -1514,7 +1517,8 @@ class ClusterNode:
             payload["trace"] = trace_id
         try:
             self._send(requester, payload)
-            self.subtasks_sent += 1
+            with self._lock:
+                self.subtasks_sent += 1
         except WireError:
             # Requester vanished between NEEDWORK and now: run the part
             # ourselves so the shed subtrees are never lost.  Mark it local
@@ -1542,7 +1546,7 @@ class ClusterNode:
             )
         with self._lock:
             self._parts[part_uuid] = root_uuid
-        self.subtasks_run += 1
+            self.subtasks_run += 1
 
         def fin(r: dict) -> None:
             with self._lock:

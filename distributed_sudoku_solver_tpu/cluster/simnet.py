@@ -58,6 +58,7 @@ from distributed_sudoku_solver_tpu.cluster.wire import (
     WireError,
     addr_str,
 )
+from distributed_sudoku_solver_tpu.obs import lockdep
 from distributed_sudoku_solver_tpu.serving.faults import FaultSchedule
 
 _LOG = logging.getLogger(__name__)
@@ -167,7 +168,7 @@ class SimNet:
         self._schedule = schedule
         self._delay_lo, self._delay_hi = delay_range
         self._seed = seed
-        self._cond = threading.Condition()
+        self._cond = lockdep.named_condition("cluster.simnet")  # lockck: name(cluster.simnet)
         self._now = 0.0
         self._closed = False
         self._seq = 0

@@ -11,16 +11,16 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional, Tuple
 
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
+from distributed_sudoku_solver_tpu.obs import lockdep
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "_libcsp.so")
-_lock = threading.Lock()
+_lock = lockdep.named_lock("native.build")  # lockck: name(native.build)
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 

@@ -29,6 +29,12 @@ through *which* chunks.  This package holds the per-job plane:
   stitched traces (round 15): an exact phase partition of each job's
   wall (``GET /trace/<uuid>?analyze=1``), mergeable per-phase
   histograms, and the slow-job watchdog.
+* :mod:`obs.lockdep` — the runtime lockdep witness (round 16): the
+  ``named_lock``/``named_rlock``/``named_condition`` factories every
+  repo lock is created through, and the install/active seam that —
+  armed across tier-1 — checks each acquisition against the manifest
+  lock hierarchy the moment it happens and accumulates the observed
+  order graph ``analysis/deadck.py`` cross-checks.
 
 Import discipline: stdlib only, like ``serving/faults.py`` — every layer
 imports ``obs``; ``obs`` imports none of them back.  (One declared

@@ -67,12 +67,11 @@ from __future__ import annotations
 import collections
 import contextlib
 import logging
-import threading
 import time
 from typing import Callable, Optional
 
 from distributed_sudoku_solver_tpu.analysis import manifest
-from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs import lockdep, trace
 from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
 from distributed_sudoku_solver_tpu.obs.logctx import ctx_log
 
@@ -159,7 +158,7 @@ class CompileWatch:
         self._clock = clock
         self.rearm_s = float(rearm_s)
         self.peak_gflops = peak_gflops
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("obs.compilewatch")  # lockck: name(obs.compilewatch)
         self._fns = dict(programs) if programs is not None else _load_programs()
         self._last_size = {}
         for name, fn in self._fns.items():

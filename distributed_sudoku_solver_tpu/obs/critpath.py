@@ -66,10 +66,10 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from distributed_sudoku_solver_tpu.obs import lockdep
 from distributed_sudoku_solver_tpu.obs import slo as slo_mod
 from distributed_sudoku_solver_tpu.obs import trace
 from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
@@ -207,7 +207,7 @@ class CritPathMonitor:
         self.slow_ms = slow_ms
         self.dump_cooldown_s = float(dump_cooldown_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("obs.critpath")  # lockck: name(obs.critpath)
         self.hist = {
             f"critpath_{p}_ms": LatencyHistogram() for p in ALL_PHASES
         }

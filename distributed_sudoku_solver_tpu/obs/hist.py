@@ -37,7 +37,7 @@ Import discipline: stdlib only (like the rest of ``obs/``).
 from __future__ import annotations
 
 import math
-import threading
+from distributed_sudoku_solver_tpu.obs import lockdep
 from typing import Optional
 
 # The one process-independent bucket scheme: first edge 1 µs, doubling
@@ -70,7 +70,7 @@ class LatencyHistogram:
     (stored and exported in ms, matching every ``*_ms`` metric)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("obs.hist")  # lockck: name(obs.hist)
         self._counts = [0] * N_BUCKETS
         self._n = 0
         self._sum_ms = 0.0
@@ -196,7 +196,7 @@ class MinEstimator:
     the process."""
 
     def __init__(self, window: int = 256):
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("obs.minest")  # lockck: name(obs.minest)
         self._window = max(1, window)
         self._min_ms: Optional[float] = None
         self._cur_min_ms: Optional[float] = None

@@ -61,12 +61,11 @@ import contextlib
 import dataclasses
 import logging
 import re
-import threading
 import time
 from collections import deque
 from typing import Callable, Optional, Sequence
 
-from distributed_sudoku_solver_tpu.obs import trace
+from distributed_sudoku_solver_tpu.obs import lockdep, trace
 from distributed_sudoku_solver_tpu.obs.logctx import ctx_log
 
 _LOG = logging.getLogger(__name__)
@@ -171,14 +170,14 @@ class SloMonitor:
         self.metrics_fn = metrics_fn
         # Dump/observe can re-enter metrics() via metrics_fn -> engine
         # .metrics() -> slo.active().metrics(): reentrant by design.
-        self._lock = threading.RLock()
+        self._lock = lockdep.named_rlock("obs.slo")  # lockck: name(obs.slo)
         # Sub-buckets: [bucket_id, total, bad-per-objective list].
         self._buckets: deque = deque()
-        self._burning = [False] * len(self.objectives)
-        self._breaches = [0] * len(self.objectives)
-        self.observed = 0
-        self.burns = 0  # threshold crossings (all objectives)
-        self.dumps = 0  # flight-recorder dumps written on crossings
+        self._burning = [False] * len(self.objectives)  # lockck: guard(_lock)
+        self._breaches = [0] * len(self.objectives)  # lockck: guard(_lock)
+        self.observed = 0  # lockck: guard(_lock)
+        self.burns = 0  # lockck: guard(_lock) — threshold crossings (all objectives)
+        self.dumps = 0  # lockck: guard(_lock) — flight-recorder dumps written on crossings
 
     # -- the observation feed ------------------------------------------------
     def observe(
@@ -289,10 +288,10 @@ class SloMonitor:
     def burning(self) -> bool:
         with self._lock:
             self._prune_locked(int(self._clock() // self._sub_s))
-            self._evaluate_locked_quiet()
+            self._evaluate_quiet_locked()
             return any(self._burning)
 
-    def _evaluate_locked_quiet(self) -> None:
+    def _evaluate_quiet_locked(self) -> None:
         """Reads must see decayed state (an idle window stops burning)
         without re-running the crossing side effects out of observe order:
         only the burning -> not-burning direction is applied here."""
@@ -311,7 +310,7 @@ class SloMonitor:
     def metrics(self) -> dict:
         with self._lock:
             self._prune_locked(int(self._clock() // self._sub_s))
-            self._evaluate_locked_quiet()
+            self._evaluate_quiet_locked()
             total, bad, rates = self._burn_rates_locked()
             return {
                 "window_s": self.window_s,

@@ -54,7 +54,7 @@ import json
 import logging
 import numbers
 import os
-import threading
+from distributed_sudoku_solver_tpu.obs import lockdep
 import time
 from typing import Callable, Iterable, Optional
 
@@ -108,7 +108,7 @@ class TraceRecorder:
         self.node = node
         self.dump_dir = dump_dir
         self.dump_spans = max(1, dump_spans)
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("obs.trace")  # lockck: name(obs.trace)
         self._ring: collections.deque = collections.deque(maxlen=max(16, ring))
         # child uuid -> root trace id (shed parts under their job), bounded
         # like the engine's stale-cancel ledger.
@@ -117,9 +117,9 @@ class TraceRecorder:
         # at-least-once delivery AND a no-op for spans this recorder itself
         # produced (nodes sharing one recorder in the simnet lane).
         self._seen: collections.OrderedDict = collections.OrderedDict()
-        self._seq = 0
-        self.dumps = 0
-        self.remote_spans_ingested = 0
+        self._seq = 0  # lockck: guard(_lock)
+        self.dumps = 0  # lockck: guard(_lock)
+        self.remote_spans_ingested = 0  # lockck: guard(_lock)
 
     # -- time ----------------------------------------------------------------
     def now(self) -> float:
@@ -161,7 +161,7 @@ class TraceRecorder:
                 "attrs": a,
             }
             self._ring.append(span)
-            self._remember(span["id"])
+            self._remember_locked(span["id"])
         return span
 
     def event(
@@ -180,7 +180,7 @@ class TraceRecorder:
             trace, name, site, t, t1=t, node=node, uuids=uuids, attrs=attrs, **kw
         )
 
-    def _remember(self, span_id: str) -> None:
+    def _remember_locked(self, span_id: str) -> None:
         self._seen[span_id] = None
         while len(self._seen) > 2 * self._ring.maxlen:
             self._seen.popitem(last=False)
@@ -271,7 +271,7 @@ class TraceRecorder:
             with self._lock:
                 if span["id"] in self._seen:
                     continue
-                self._remember(span["id"])
+                self._remember_locked(span["id"])
                 self._ring.append(span)
                 self.remote_spans_ingested += 1
             n += 1

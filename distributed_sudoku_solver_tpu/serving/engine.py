@@ -54,7 +54,13 @@ import jax
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry, geometry_for_size
-from distributed_sudoku_solver_tpu.obs import compilewatch, critpath, slo, trace
+from distributed_sudoku_solver_tpu.obs import (
+    compilewatch,
+    critpath,
+    lockdep,
+    slo,
+    trace,
+)
 from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram, MinEstimator
 from distributed_sudoku_solver_tpu.obs.logctx import job_log, uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
@@ -198,7 +204,9 @@ class _Control:
     k: int = 8
     fn: Any = None  # 'exec': zero-arg callable run on the device-owner thread
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
-    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    lock: Any = dataclasses.field(  # lockck: name(serving.control)
+        default_factory=lambda: lockdep.named_lock("serving.control")
+    )
     abandoned: bool = False
     claimed: bool = False  # servicer took it; abandon is no longer possible
     result: Any = None
@@ -318,7 +326,7 @@ class SolverEngine:
         # Insertion-ordered so stale entries (cancels for jobs that already
         # finished or never arrive) can be pruned oldest-first.
         self._cancelled: "dict[str, None]" = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("serving.engine")  # lockck: name(serving.engine)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Counters (single-writer: the device loop; readers tolerate staleness).

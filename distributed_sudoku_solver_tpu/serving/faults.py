@@ -38,8 +38,11 @@ cache stays warm):
   static flights), half-open after a cooldown (one rebuild attempt
   probes), closed again on the first successfully consumed chunk.
 
-Import discipline: stdlib only.  Engine, scheduler, bulk, and cluster all
-import this module; it must never import them back.
+Import discipline: stdlib plus the (itself stdlib-only) ``obs.lockdep``
+named-lock factory — the declared carve-out in ``manifest.LAYERS`` that
+puts this module's two locks in the one deadck/lockdep hierarchy.
+Engine, scheduler, bulk, and cluster all import this module; it must
+never import them back.
 """
 
 from __future__ import annotations
@@ -48,10 +51,11 @@ import contextlib
 import dataclasses
 import random
 import re
-import threading
 import time
 import zlib
 from typing import Callable, Iterable, Optional
+
+from distributed_sudoku_solver_tpu.obs import lockdep
 
 # -- taxonomy -----------------------------------------------------------------
 
@@ -206,7 +210,7 @@ class CircuitBreaker:
 
     def __init__(self, policy: RecoveryPolicy):
         self.policy = policy
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("serving.breaker")  # lockck: name(serving.breaker)
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self.transitions = 0  # state changes, for observability/tests
@@ -347,7 +351,7 @@ class FaultInjector:
     ):
         self.schedule = schedule
         self.poison_jobs = frozenset(poison_jobs)
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("serving.injector")  # lockck: name(serving.injector)
         self._idx: dict = {}  # site -> next dispatch index
         self.injected: dict = {}  # (site, kind) -> count
 

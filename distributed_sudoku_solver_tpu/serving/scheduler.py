@@ -55,7 +55,6 @@ import dataclasses
 import functools
 import logging
 import math
-import threading
 from collections import deque
 from typing import Optional
 
@@ -64,7 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
-from distributed_sudoku_solver_tpu.obs import compilewatch, trace
+from distributed_sudoku_solver_tpu.obs import compilewatch, lockdep, trace
 from distributed_sudoku_solver_tpu.obs.logctx import uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import (
     Frontier,
@@ -246,7 +245,7 @@ class ResidentFlight:
         self.slots: list = [None] * self.n_slots  # slot -> Job
         self._free: deque = deque(range(self.n_slots))  # slot recycler
         self._pending: deque = deque()  # FIFO admission queue
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("serving.scheduler")  # lockck: name(serving.scheduler)
         self._closed = False
         # Self-healing (serving/faults.py): a failed device program no
         # longer closes admission forever.  Transient failures rebuild the
@@ -704,6 +703,7 @@ class ResidentFlight:
             # from in-graph deltas, so zeroing lane_rounds (which a
             # never-retiring resident frontier grows forever — a latent
             # round-7 overflow) is invisible to every consumer.
+            # deadck: allow(single-writer: ResidentFlight.state is only ever mutated on the device loop; solve_file's reach is a static over-approximation through the shared advance helpers)
             self.state = self.state._replace(
                 steps=jnp.int32(0),
                 lane_rounds=jnp.zeros_like(self.state.lane_rounds),

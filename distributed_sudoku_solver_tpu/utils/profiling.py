@@ -19,6 +19,8 @@ from __future__ import annotations
 import contextlib
 import logging
 import threading
+
+from distributed_sudoku_solver_tpu.obs import lockdep
 from typing import Iterator, Optional
 
 import numpy as np
@@ -70,7 +72,7 @@ def device_trace(logdir: str) -> Iterator[None]:
 # long-lived node must never be left tracing unboundedly because a client
 # forgot a second request.
 
-_window_lock = threading.Lock()
+_window_lock = lockdep.named_lock("utils.profile_window")  # lockck: name(utils.profile_window)
 _window_active = False
 
 
@@ -117,7 +119,7 @@ class StatWindow:
     def __init__(self, capacity: int = 1024):
         self._buf = np.zeros(capacity, dtype=np.float64)
         self._n = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("utils.statwindow")  # lockck: name(utils.statwindow)
 
     def record(self, value: float) -> None:
         with self._lock:

@@ -165,6 +165,55 @@ def _simnet_purity_guard(request, monkeypatch):
     assert not violations, "simnet purity violations:\n" + "\n".join(violations)
 
 
+# --------------------------------------------------------------------------
+# Lockdep witness (ISSUE 13): the runtime half of the deadck thread-plane
+# contract, armed across the WHOLE tier-1 suite.  Every named lock
+# acquisition is checked against the manifest hierarchy the moment it
+# happens — a violating or cycle-forming acquisition raises in the thread
+# that would have deadlocked — and is accumulated into one process-wide
+# observed graph that tests/test_deadck.py cross-checks against deadck's
+# predicted graph.  A raise on a daemon thread (device loop, heartbeat,
+# handler) can be swallowed by that thread's catch-all, so the per-test
+# guard below also asserts no NEW violations were recorded during the
+# test — the simnet purity guard's record-and-raise pattern.
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="session", autouse=True)
+def lockdep_witness():
+    from distributed_sudoku_solver_tpu.obs import lockdep
+
+    witness = lockdep.manifest_witness(strict=True)
+    lockdep.install(witness)
+    yield witness
+    lockdep.install(None)
+    # The whole-suite cross-check (the acceptance twin of the explicit
+    # test in tests/test_deadck.py, which can only see the tests that ran
+    # BEFORE it): every edge observed across the entire session must be
+    # in deadck's predicted graph.  Cheap (stdlib-ast, ~1 s) and failing
+    # loudly at session end beats silently shipping a blind spot.
+    from distributed_sudoku_solver_tpu.analysis.__main__ import run as _arun
+
+    report, _ = _arun(rules=("deadck",))
+    predicted = {tuple(e) for e in report["deadck"]["predicted"]}
+    unpredicted = sorted(set(witness.graph()) - predicted)
+    assert not unpredicted, (
+        "tier-1 observed lock-order edges deadck did not predict "
+        f"(fix the resolver or declare them): {unpredicted}"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_violation_guard(lockdep_witness):
+    before = len(lockdep_witness.violations)
+    yield
+    fresh = lockdep_witness.violations[before:]
+    assert not fresh, (
+        "lock-order violations recorded during this test:\n"
+        + "\n".join(repr(v) for v in fresh)
+    )
+
+
 @pytest.fixture
 def heavy_compile_guard():
     """Request this before any outsized XLA:CPU compile (see module note).

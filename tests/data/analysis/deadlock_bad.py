@@ -1,0 +1,55 @@
+"""deadck fixture: every finding shape the rule must catch.
+
+Driven by tests/test_deadck.py with an injected config (ranks
+``t.a``=20 > ``t.b``=10, thread roots ``root_one``/``root_two``) — the
+real manifest never sees this module.
+"""
+
+import threading
+
+from distributed_sudoku_solver_tpu.obs import lockdep
+
+raw = threading.Lock()  # unnamed creation: a deadck finding
+
+
+class A:
+    def __init__(self):
+        self._lock = lockdep.named_lock("t.a")  # lockck: name(t.a)
+        self.shared = 0
+
+    def outer(self):
+        with self._lock:
+            helper()  # cross-function edge t.a -> t.b (rank-violating)
+
+    def renest(self):
+        with self._lock:
+            with self._lock:  # direct self-acquisition of a plain lock
+                pass
+
+    def writes(self):
+        self.shared += 1  # multi-root write, no guard, no lock held
+
+
+class B:
+    def __init__(self):
+        # Annotation disagrees with the factory argument: a finding.
+        self._lock = lockdep.named_lock("t.b")  # lockck: name(t.mismatch)
+
+    def inner(self):
+        with self._lock:
+            pass
+
+
+def helper():
+    b = B()
+    b.inner()
+
+
+def root_one():
+    a = A()
+    a.writes()
+
+
+def root_two():
+    a = A()
+    a.writes()

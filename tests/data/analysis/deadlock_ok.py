@@ -1,0 +1,53 @@
+"""deadck clean fixture: named locks, rank-upward nesting, guarded and
+waived multi-root writes.  Injected config: ranks ``t.a``=20 < ``t.b``=30,
+thread roots ``root_one``/``root_two``."""
+
+from distributed_sudoku_solver_tpu.obs import lockdep
+
+
+class A:
+    def __init__(self):
+        self._lock = lockdep.named_lock("t.a")  # lockck: name(t.a)
+        self.guarded = 0  # lockck: guard(_lock)
+        self.under_lock = 0
+        self.tolerated = 0
+
+    def outer(self):
+        with self._lock:
+            helper()  # t.a -> t.b: rank-upward, fine
+
+    def writes(self):
+        with self._lock:
+            self.guarded += 1
+            self.under_lock += 1  # lexical guard satisfies the inference
+        # deadck: allow(single-writer by design; readers tolerate staleness)
+        self.tolerated += 1
+
+    def flip_locked(self):
+        # The *_locked caller-holds-it convention: analyzed as holding t.a.
+        self.under_lock -= 1
+
+
+class B:
+    def __init__(self):
+        self._lock = lockdep.named_lock("t.b")  # lockck: name(t.b)
+
+    def inner(self):
+        with self._lock:
+            pass
+
+
+def helper():
+    b = B()
+    b.inner()
+
+
+def root_one():
+    a = A()
+    a.writes()
+
+
+def root_two():
+    a = A()
+    a.writes()
+    a.outer()

@@ -332,7 +332,7 @@ def test_package_tree_has_no_stale_waivers():
 # -- the tier-1 gate -----------------------------------------------------------
 
 def test_runner_clean_and_jax_free_over_package():
-    """The acceptance pin: all four rules over the real tree, exit 0, no
+    """The acceptance pin: all five fast rules over the real tree, exit 0, no
     jax in the process, inside the <5 s budget (measured ~1 s; the budget
     includes interpreter start on a loaded 2-core container)."""
     code = (
@@ -356,7 +356,12 @@ def test_runner_clean_and_jax_free_over_package():
         proc.stderr[-4000:],
     )
     report = json.loads(proc.stdout)
-    assert set(report["rules"]) == {"layerck", "clockck", "syncck", "lockck"}
+    assert set(report["rules"]) == {
+        "layerck", "clockck", "syncck", "lockck", "deadck",
+    }
+    # The thread-plane rule ships its predicted graph for the runtime
+    # cross-check (tests/test_deadck.py).
+    assert report["deadck"]["predicted"], report.get("deadck")
     assert all(
         r["violations"] == [] for r in report["rules"].values()
     ), report

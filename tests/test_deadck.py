@@ -1,0 +1,360 @@
+"""The thread-plane contract (ISSUE 13): deadck's static lock-order
+graph, the obs/lockdep runtime witness, and the cross-check that binds
+them.
+
+Lanes:
+
+* fixture lane — synthetic modules driven through ``deadck.check_modules``
+  with injected ranks/roots, pinning that every finding shape actually
+  FIRES (unnamed lock, annotation mismatch, rank-violating cross-function
+  edge, cycle, unguarded multi-root write) and that the clean shapes pass;
+* runtime lane — the witness raises on hierarchy-violating and
+  cycle-forming acquisitions at the moment they happen, recognizes RLock
+  re-entrancy, and its disabled path is one global read + branch (the
+  explode microcheck);
+* the contract — the slo burn-dump re-entrancy is a DECLARED edge
+  exercised end to end without deadlock, and the session-wide observed
+  acquisition graph is a subset of deadck's predicted graph;
+* thread lifecycle — ``wire.fanout_requests`` releases its per-peer
+  daemon thread once the virtual deadline expires (simnet lane, no
+  sleeps).
+"""
+
+import threading
+from pathlib import Path
+
+import pytest
+
+from distributed_sudoku_solver_tpu.analysis import deadck, manifest
+from distributed_sudoku_solver_tpu.analysis.__main__ import run as analysis_run
+from distributed_sudoku_solver_tpu.analysis.common import SourceModule
+from distributed_sudoku_solver_tpu.obs import lockdep, slo, trace
+
+FIXTURES = Path(__file__).resolve().parent / "data" / "analysis"
+
+RANKS_BAD = {"t.a": 20, "t.b": 10}
+RANKS_OK = {"t.a": 20, "t.b": 30}
+ROOTS = {"deadlock_bad.py": ("root_one", "root_two"),
+         "deadlock_ok.py": ("root_one", "root_two")}
+
+
+def load(name: str) -> SourceModule:
+    return SourceModule(FIXTURES / name, name, None)
+
+
+def run_fixture(name, ranks, declared=None):
+    return deadck.check_modules(
+        [load(name)],
+        ranks=ranks,
+        declared=declared or {},
+        base_classes={},
+        thread_roots=ROOTS,
+    )
+
+
+# -- fixture lane --------------------------------------------------------------
+
+def test_deadck_fires_on_every_finding_shape():
+    findings, summary = run_fixture("deadlock_bad.py", RANKS_BAD)
+    live = [f for f in findings if not f.waived]
+    msgs = " | ".join(f.message for f in live)
+    assert "unnamed lock" in msgs
+    assert "disagrees with the factory argument" in msgs
+    # The cross-function edge: outer holds t.a, helper() -> B.inner
+    # acquires t.b; rank 20 >= 10 is a hierarchy violation.
+    assert "lock-order edge 't.a'" in msgs and "'t.b'" in msgs
+    # The unguarded multi-root write.
+    assert "attribute 'shared' of A" in msgs and "2 thread roots" in msgs
+    # Direct re-acquisition of a held non-reentrant lock.
+    assert "self-acquisition of non-reentrant lock 't.a'" in msgs
+    # The predicted graph carries the edge with its provenance.
+    assert ["t.a", "t.b"] in summary["predicted"]
+
+
+def test_deadck_clean_fixture_and_waiver():
+    findings, summary = run_fixture("deadlock_ok.py", RANKS_OK)
+    live = [f for f in findings if not f.waived]
+    assert live == [], live
+    waived = [f for f in findings if f.waived]
+    assert len(waived) == 1 and "tolerated" in waived[0].message
+    assert waived[0].reason
+    assert ["t.a", "t.b"] in summary["predicted"]
+
+
+def test_deadck_cycle_finding_via_declared_edges():
+    # The static edge t.a -> t.b plus a declared reverse edge closes a
+    # cycle: declared edges are part of the predicted graph, and a cycle
+    # is a finding even when every edge in it is individually blessed.
+    findings, _ = run_fixture(
+        "deadlock_ok.py", RANKS_OK, declared={("t.b", "t.a"): "fixture"}
+    )
+    assert any("cycle in the predicted lock-order graph" in f.message
+               for f in findings), findings
+
+
+# -- runtime lane --------------------------------------------------------------
+
+def test_lockdep_rank_violation_raises_and_is_recorded():
+    w = lockdep.LockWitness(ranks={"lo": 1, "hi": 2}, declared={})
+    lo, hi = lockdep.named_lock("lo"), lockdep.named_lock("hi")
+    with lockdep.installed(w):
+        with lo:
+            with hi:
+                pass  # rank-upward: fine
+        with hi:
+            with pytest.raises(lockdep.LockOrderError):
+                lo.acquire()
+    assert [v["edge"] for v in w.violations] == [["hi", "lo"]]
+    # The legal edge was recorded; the witness graph is the artifact.
+    assert ("lo", "hi") in set(w.graph())
+
+
+def test_lockdep_cycle_raises_even_for_declared_edges():
+    # a->b then b->a: both declared, but the second acquisition closes a
+    # cycle in the OBSERVED graph — the witness raises at that moment
+    # (declarations cannot bless an actual deadlock shape).
+    w = lockdep.LockWitness(
+        ranks={}, declared={("a", "b"): "r", ("b", "a"): "r"}
+    )
+    a, b = lockdep.named_lock("a"), lockdep.named_lock("b")
+    with lockdep.installed(w):
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(lockdep.LockOrderError):
+                a.acquire()
+    assert any("cycle" in v["problem"] for v in w.violations)
+
+
+def test_lockdep_self_deadlock_on_plain_lock_raises():
+    # Re-acquiring a held non-RLock would block this thread forever; the
+    # witness raises BEFORE the acquire blocks (review-round finding:
+    # the re-entrancy fast path used to treat this as benign).
+    w = lockdep.LockWitness(ranks={"x": 1}, declared={})
+    x = lockdep.named_lock("x")
+    with lockdep.installed(w):
+        with x:
+            with pytest.raises(lockdep.LockOrderError):
+                x.acquire()
+    assert any("self-deadlock" in v["problem"] for v in w.violations)
+
+
+def test_lockdep_unknown_lock_is_a_violation():
+    w = lockdep.LockWitness(ranks={"known": 1}, declared={})
+    known, ghost = lockdep.named_lock("known"), lockdep.named_lock("ghost")
+    with lockdep.installed(w):
+        with known:
+            with pytest.raises(lockdep.LockOrderError):
+                ghost.acquire()
+    assert "LOCK_RANKS" in w.violations[0]["problem"]
+
+
+def test_lockdep_rlock_reentrancy_records_no_edge():
+    # The slo shape: hold an outer RLock, take an inner lock, re-enter
+    # the outer.  Re-entrant acquisition is ownership, not ordering — no
+    # edge, no cycle, no violation.
+    w = lockdep.LockWitness(ranks={"outer": 1, "inner": 2}, declared={})
+    outer, inner = lockdep.named_rlock("outer"), lockdep.named_lock("inner")
+    with lockdep.installed(w):
+        with outer:
+            with inner:
+                with outer:  # re-entrant while holding inner
+                    pass
+    assert w.violations == []
+    assert set(w.graph()) == {("outer", "inner")}
+
+
+def test_lockdep_nonblocking_failed_acquire_does_not_corrupt_stack():
+    w = lockdep.LockWitness(ranks={"x": 1, "y": 2}, declared={})
+    x, y = lockdep.named_lock("x"), lockdep.named_lock("y")
+    with lockdep.installed(w):
+        with x:
+            got = x._real.acquire(False) if False else None  # noqa: F841
+            # A failed non-blocking acquire from another "thread"'s view:
+            # simulate by acquiring y's real lock first so the proxy
+            # attempt fails.
+            y._real.acquire()
+            try:
+                assert y.acquire(blocking=False) is False
+            finally:
+                y._real.release()
+        # Stack unwound cleanly: a later acquisition records only the
+        # real edge.
+        with y:
+            pass
+    assert w.violations == []
+    assert set(w.graph()) == {("x", "y")}
+
+
+def test_lockdep_condition_wait_keeps_stack_honest():
+    w = lockdep.LockWitness(ranks={"cond": 1, "other": 2}, declared={})
+    cond = lockdep.named_condition("cond")
+    other = lockdep.named_lock("other")
+    hits = []
+
+    def waiter():
+        with cond:
+            hits.append("waiting")
+            cond.wait(timeout=30)
+            hits.append("woke")
+
+    with lockdep.installed(w):
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        ev = threading.Event()
+        while not hits:
+            ev.wait(0.01)
+        with cond:
+            cond.notify_all()
+        t.join(30)
+        assert hits == ["waiting", "woke"]
+        # After the wait round-trip the waiter's stack is clean: an
+        # unrelated acquisition on this thread records nothing stale.
+        with other:
+            pass
+    assert w.violations == []
+    assert ("cond", "other") not in set(w.graph())
+
+
+def test_lockdep_disabled_path_is_one_read_one_branch(monkeypatch):
+    """The explode microcheck (faults/trace/slo pattern): with no witness
+    installed, acquiring a named lock must never touch the witness
+    machinery — LockWitness.acquire is patched to explode, and a
+    lock-heavy surface (histogram record under its named lock, trace
+    record, engine counters) runs clean."""
+    monkeypatch.setattr(lockdep, "_WITNESS", None)
+
+    def boom(*a, **k):  # pragma: no cover - the test is that it never runs
+        raise AssertionError("disabled lockdep path touched the witness")
+
+    monkeypatch.setattr(lockdep.LockWitness, "acquire", boom)
+    monkeypatch.setattr(lockdep.LockWitness, "released", boom)
+    from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
+
+    h = LatencyHistogram()
+    for i in range(16):
+        h.record(0.001 * (i + 1))
+    assert len(h) == 16
+    rec = trace.TraceRecorder()
+    rec.record(None, "x", "site", 0.0, 1.0)
+    assert rec.metrics()["spans"] >= 1
+
+
+# -- the declared slo re-entrancy contract (ISSUE 13 satellite) ----------------
+
+def test_slo_edge_is_declared_with_reason():
+    edge = ("obs.slo", "serving.engine")
+    assert edge in manifest.LOCK_EDGE_DECLARED
+    assert "metrics_fn" in manifest.LOCK_EDGE_DECLARED[edge]
+    # Declared edges are part of deadck's predicted graph.
+    report, _ = analysis_run(rules=("deadck",))
+    assert ["obs.slo", "serving.engine"] in report["deadck"]["predicted"]
+
+
+def test_slo_burn_dump_reenters_engine_metrics_without_deadlock(
+    tmp_path, lockdep_witness
+):
+    """Satellite pin: a burn-dump fired inside SloMonitor._lock re-enters
+    engine.metrics -> mon.metrics (the RLock) and must complete — under
+    the ARMED witness, so the slo->engine acquisition is checked against
+    the declared edge the moment it happens."""
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+    rec = trace.TraceRecorder(dump_dir=str(tmp_path))
+    mon = slo.SloMonitor(
+        slo.parse_slo("job_p95_ms<=0.0001"), min_samples=1
+    )
+    eng = SolverEngine().start()
+    mon.metrics_fn = eng.metrics
+    trace.install(rec)
+    slo.install(mon)
+    try:
+        job = eng.submit(EASY_9)
+        assert job.done.wait(120)
+        state = mon.metrics()  # the read-back re-enters the RLock too
+    finally:
+        slo.install(None)
+        trace.install(None)
+        eng.stop()
+    assert state["burns"] >= 1 and state["dumps"] >= 1
+    assert list(tmp_path.glob("flightrec-*-slo_burn.json")), "burn dump not written"
+    observed = set(lockdep_witness.graph())
+    assert ("obs.slo", "serving.engine") in observed
+    assert lockdep_witness.violations == []
+
+
+# -- the cross-check: observed subset of predicted -----------------------------
+
+def test_observed_graph_is_subset_of_predicted(lockdep_witness):
+    """The acceptance cross-check (jaxck's golden discipline applied to
+    concurrency): every edge the session-wide witness has observed — this
+    test runs after any number of engine/cluster/obs tests in the same
+    process — must be in deadck's predicted graph (static edges UNION
+    the declared table).  An observed edge deadck did not predict is a
+    deadck bug: fix the resolver or declare the edge with a reason."""
+    report, findings = analysis_run(rules=("deadck",))
+    assert [f for f in findings if not f.waived] == []
+    predicted = {tuple(e) for e in report["deadck"]["predicted"]}
+    observed = set(lockdep_witness.graph())
+    unpredicted = sorted(observed - predicted)
+    assert not unpredicted, (
+        "runtime-observed lock edges missing from deadck's predicted "
+        f"graph: {unpredicted}"
+    )
+    assert lockdep_witness.violations == []
+
+
+# -- fanout thread lifecycle (simnet lane) -------------------------------------
+
+@pytest.mark.simnet
+def test_fanout_requests_releases_blocked_thread_on_deadline(request):
+    """A metrics pull to a peer whose reply is delayed past the per-peer
+    deadline must not leak a blocked daemon thread: the fan-out worker
+    parks on the VIRTUAL clock, the caller returns with the peer flagged
+    unreachable, and advancing past the deadline releases the worker —
+    thread count returns to baseline with no sleeps."""
+    from distributed_sudoku_solver_tpu.cluster.node import (
+        ClusterConfig,
+        ClusterNode,
+    )
+    from distributed_sudoku_solver_tpu.cluster.simnet import SimNet, wait_until
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.serving.faults import FaultSchedule
+
+    net = SimNet(delay_range=(10.0, 10.0))  # any delayed frame misses 0.5 s
+    cfg = ClusterConfig(heartbeat_s=60.0, stats_timeout_s=0.5)
+    e1 = SolverEngine().start()
+    e2 = SolverEngine().start()
+    n1 = ClusterNode(
+        e1, host="127.0.0.1", port=0, config=cfg,
+        transport=net.transport(), clock=net.clock,
+    ).start()
+    n2 = ClusterNode(
+        e2, host="127.0.0.1", port=0, config=cfg, anchor=n1.addr,
+        transport=net.transport(), clock=net.clock,
+    ).start()
+    try:
+        assert wait_until(net, lambda: len(n1.network) == 2, timeout=120)
+        net.settle()
+        baseline = threading.active_count()
+        # Delay the first METRICS_PULL n1 -> n2 past the 0.5 s deadline.
+        site = f"link:{n1.addr_s}->{n2.addr_s}:METRICS_PULL"
+        net.set_schedule(FaultSchedule.at({site: {0: "delay"}}))
+        view = n1.cluster_metrics_view()
+        assert view["nodes"][n2.addr_s]["unreachable"] is True
+        # The fan-out worker is still parked on the virtual deadline —
+        # the leak window this test pins.  Advancing virtual time past
+        # the deadline (and the delayed delivery) releases it.
+        net.set_schedule(None)
+        net.advance(11.0)
+        assert wait_until(
+            net, lambda: threading.active_count() <= baseline, timeout=120
+        ), f"leaked threads: {threading.active_count()} > {baseline}"
+    finally:
+        n2.stop()
+        n1.stop()
+        e2.stop()
+        e1.stop()
+        net.close()

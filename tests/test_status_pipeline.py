@@ -285,8 +285,8 @@ def counted_fetches(monkeypatch):
 
 
 @pytest.fixture(
-    params=["untraced", "traced", "watched"],
-    ids=["untraced", "traced", "watched"],
+    params=["untraced", "traced", "watched", "lockdep"],
+    ids=["untraced", "traced", "watched", "lockdep"],
 )
 def tracing(request):
     """Run the sync-count guards three ways: the round-11 trace plane
@@ -300,6 +300,19 @@ def tracing(request):
     (the ISSUE-12 zero-added-syncs acceptance)."""
     if request.param == "untraced":
         yield None
+        return
+    if request.param == "lockdep":
+        # ISSUE-13 acceptance: the one-sync-per-chunk guard re-runs with
+        # a FRESH armed lock witness (scoped over the session one) and
+        # the counts must be bit-identical — the witness's per-acquire
+        # bookkeeping adds zero host syncs and zero hierarchy
+        # violations on the hot loop.
+        from distributed_sudoku_solver_tpu.obs import lockdep
+
+        with lockdep.installed(lockdep.manifest_witness(strict=True)) as w:
+            yield None
+        assert w.violations == [], w.violations
+        assert w.acquisitions > 0  # vacuity: the loop did take locks
         return
     from distributed_sudoku_solver_tpu.obs import trace
 
