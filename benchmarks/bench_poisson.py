@@ -31,6 +31,15 @@ directly observable.
 Run: ``python benchmarks/bench_poisson.py [--jobs 48] [--mean-ms 50]
 [--handicap-ms 50] [--json]``.  The tier-1 smoke and the ``slow``-marked
 assertion live in ``tests/test_scheduler.py``.
+
+``--mix easy:N,hard:M,repeat:R`` (round 17) swaps the all-hard corpus
+for a realistic mixed-difficulty stream — distinct easy and hard boards
+plus *symmetry-transformed* repeats of already-sent ones — and runs both
+engines behind the front door (``serving/frontdoor``), reporting
+per-route and per-tier percentiles beside the overall numbers.  Mixed
+artifacts carry the mix in ``params``; ``benchmarks/regress.py`` refuses
+to compare artifacts with different mixes (exit 2 — different workload,
+not a regression).
 """
 
 from __future__ import annotations
@@ -40,6 +49,7 @@ import random
 import sys
 import threading
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -92,38 +102,162 @@ def _corpus(n_jobs: int):
     return [np.asarray(HARD_9[i % len(HARD_9)]) for i in range(n_jobs)]
 
 
+def parse_mix(spec: str) -> dict:
+    """``easy:N,hard:M,repeat:R`` -> counts dict (missing tiers = 0)."""
+    mix = {"easy": 0, "hard": 0, "repeat": 0}
+    for part in spec.split(","):
+        try:
+            tier, count = part.split(":")
+            if tier.strip() not in mix:
+                raise ValueError
+            mix[tier.strip()] = int(count)
+        except ValueError:
+            raise SystemExit(
+                f"bad --mix component {part!r}: expected easy:N,hard:M,repeat:R"
+            ) from None
+    if sum(mix.values()) < 1:
+        raise SystemExit("--mix needs at least one board")
+    return mix
+
+
+def mixed_corpus(mix: dict, seed: int):
+    """A realistic mixed-difficulty arrival stream (ISSUE 14 satellite):
+
+    * ``easy``: distinct generated puzzles with generous clues — the
+      propagation/native tier's traffic.
+    * ``hard``: the published hard benchmark boards first (distinct
+      orbits), then distinct sparse generated puzzles — the device tier.
+    * ``repeat``: a random *symmetry transform* of a random already-sent
+      board — the published-puzzle aliasing the canonical cache
+      collapses (never a byte-identical resubmit, always an equivalent).
+
+    Returns ``(boards, tiers)`` with tiers shuffled deterministically in
+    ``seed`` (a repeat slot before any board was sent becomes an easy).
+    """
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.serving.frontdoor.canonical import (
+        apply_transform,
+        random_transform,
+    )
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, make_puzzle
+
+    rng = np.random.default_rng(seed)
+    tiers = (
+        ["easy"] * mix["easy"] + ["hard"] * mix["hard"]
+        + ["repeat"] * mix["repeat"]
+    )
+    rng.shuffle(tiers)
+    boards, out_tiers = [], []
+    n_easy = n_hard = 0
+    for tier in tiers:
+        if tier == "repeat" and not boards:
+            tier = "easy"
+        if tier == "easy":
+            b = make_puzzle(SUDOKU_9, seed=seed + 1000 + n_easy, n_clues=38)
+            n_easy += 1
+        elif tier == "hard":
+            if n_hard < len(HARD_9):
+                b = np.asarray(HARD_9[n_hard])
+            else:
+                b = make_puzzle(SUDOKU_9, seed=seed + 5000 + n_hard, n_clues=24)
+            n_hard += 1
+        else:
+            src = boards[int(rng.integers(len(boards)))]
+            b = apply_transform(src, random_transform(SUDOKU_9, rng))
+        boards.append(np.asarray(b, np.int64))
+        out_tiers.append(tier)
+    return boards, out_tiers
+
+
+def _grouped_percentiles(lats, keys) -> dict:
+    """Per-group latency percentiles, skipping empty groups and jobs
+    that missed the timeout (inf)."""
+    out = {}
+    groups = sorted(set(keys))
+    for grp in groups:
+        sel = [
+            lats[i]
+            for i, k in enumerate(keys)
+            if k == grp and lats[i] != float("inf")
+        ]
+        if sel:
+            out[str(grp)] = _percentiles(sel)
+    return out
+
+
 def compare_poisson(
     n_jobs: int = 48,
     mean_gap_s: float = 0.05,
     handicap_s: float = 0.05,
     seed: int = 7,
     chunk_steps: int = 8,
+    mix: Optional[dict] = None,
 ) -> dict:
     """One A/B: identical arrival schedule against a static-flight engine
     and a resident-flight engine (same solver config, same chunk
-    granularity, same handicap)."""
+    granularity, same handicap).
+
+    With ``mix`` (parse_mix counts), the corpus is the mixed-difficulty
+    stream from :func:`mixed_corpus` and BOTH engines run behind the
+    front door (``serving/frontdoor``) — the configuration a
+    million-user node actually serves.  Per-route and per-tier
+    percentiles land beside the overall numbers: cache/native routes
+    never pay the handicapped device fetch seam, so no dispatch floor
+    applies to them.
+    """
     from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
     from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
     from distributed_sudoku_solver_tpu.serving.scheduler import ResidentConfig
 
     cfg = SolverConfig(min_lanes=8, stack_slots=16)
-    boards = _corpus(n_jobs)
+    tiers = None
+    if mix is not None:
+        boards, tiers = mixed_corpus(mix, seed)
+        n_jobs = len(boards)
+    else:
+        boards = _corpus(n_jobs)
     out: dict = {
         "jobs": n_jobs,
         "mean_gap_ms": mean_gap_s * 1e3,
         "handicap_ms": handicap_s * 1e3,
     }
+    if mix is not None:
+        out["mix"] = dict(mix)
+
+    def _make_frontdoor():
+        if mix is None:
+            return None
+        from distributed_sudoku_solver_tpu.serving.frontdoor.router import (
+            FrontDoorConfig,
+        )
+
+        return FrontDoorConfig()
+
+    def _warm(engine):
+        # Warm the compile caches so both sides measure scheduling, not
+        # XLA — bypassing the front door so the warm board never seeds
+        # the measured run's result cache.
+        w = engine.submit(boards[0], frontdoor=False)
+        assert w.wait(300)
+
+    def _route_tier_sections(dst: dict, lats, jobs):
+        if mix is None:
+            return
+        dst["routes"] = _grouped_percentiles(
+            lats, [j.route or "direct" for j in jobs]
+        )
+        dst["tiers"] = _grouped_percentiles(lats, tiers)
 
     static = SolverEngine(
-        config=cfg, max_batch=8, handicap_s=handicap_s, chunk_steps=chunk_steps
+        config=cfg, max_batch=8, handicap_s=handicap_s,
+        chunk_steps=chunk_steps, frontdoor=_make_frontdoor(),
     ).start()
     try:
-        # Warm the compile caches so both sides measure scheduling, not XLA.
-        w = static.submit(boards[0])
-        assert w.wait(300)
+        _warm(static)
         lats, jobs = poisson_load(static, boards, mean_gap_s, seed)
         assert all(j.solved for j in jobs), "static baseline failed a job"
         out["static"] = _percentiles(lats)
+        _route_tier_sections(out["static"], lats, jobs)
         m = static.metrics()
         out["static_walls"] = {
             k: m[k] for k in ("dispatch_wall_ms", "sync_wall_ms") if k in m
@@ -143,16 +277,21 @@ def compare_poisson(
             attach_batch=8,
             chunk_steps=chunk_steps,
         ),
+        frontdoor=_make_frontdoor(),
     ).start()
     try:
-        w = resident.submit(boards[0])
-        assert w.wait(300)
+        _warm(resident)
         lats, jobs = poisson_load(resident, boards, mean_gap_s, seed)
         assert all(j.solved for j in jobs), "resident engine failed a job"
         out["resident"] = _percentiles(lats)
+        _route_tier_sections(out["resident"], lats, jobs)
         m_full = resident.metrics()
-        rm = m_full["resident"]["9x9"]
+        # A mixed corpus may route every board away from the device, in
+        # which case no resident flight was ever built.
+        rm = m_full.get("resident", {}).get("9x9", {})
         out["resident_metrics"] = rm
+        if "frontdoor" in m_full:
+            out["frontdoor"] = m_full["frontdoor"]
         # Normalized-artifact fields (--out-json / benchmarks/regress.py):
         # the phase histograms (mergeable obs/hist.py dicts) and the live
         # rpc_floor estimate from the run's chunk.sync samples.
@@ -189,6 +328,16 @@ def main() -> None:
     ap.add_argument("--handicap-ms", type=float, default=50.0)
     ap.add_argument("--chunk-steps", type=int, default=8)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument(
+        "--mix",
+        default=None,
+        help="mixed-difficulty corpus 'easy:N,hard:M,repeat:R' (repeats "
+        "are random symmetry transforms of already-sent boards); both "
+        "engines then run behind the front door (serving/frontdoor) and "
+        "per-route/per-tier percentiles are reported.  --jobs is ignored "
+        "(the mix counts size the corpus).  Artifacts with different "
+        "mixes are non-comparable in benchmarks/regress.py (exit 2)",
+    )
     ap.add_argument("--json", action="store_true")
     ap.add_argument(
         "--trace-out",
@@ -232,6 +381,7 @@ def main() -> None:
             handicap_s=args.handicap_ms / 1e3,
             seed=args.seed,
             chunk_steps=args.chunk_steps,
+            mix=parse_mix(args.mix) if args.mix else None,
         )
     finally:
         compilewatch_mod.install(None)
@@ -281,11 +431,15 @@ def main() -> None:
             # Versioned so regress.py can refuse cross-schema compares.
             "schema": "dsst-bench-poisson/1",
             "params": {
-                "jobs": args.jobs,
+                "jobs": out["jobs"],
                 "mean_gap_ms": args.mean_ms,
                 "handicap_ms": args.handicap_ms,
                 "chunk_steps": args.chunk_steps,
                 "seed": args.seed,
+                # Only present on mixed-corpus runs: pre-round-17
+                # artifacts stay byte-compatible (and comparable) for
+                # the default all-hard corpus.
+                **({"mix": args.mix} if args.mix else {}),
             },
             "static": out["static"],
             "resident": out["resident"],
@@ -323,6 +477,23 @@ def main() -> None:
             sp99=out.get("speedup_p99"),
         )
     )
+    if "mix" in out:
+        print(f"mix: {out['mix']}  (resident engine breakdown)")
+        for section in ("tiers", "routes"):
+            for name, r in sorted(out["resident"].get(section, {}).items()):
+                print(
+                    f"  {section[:-1]}:{name:<12}{r['p50_ms']:>10}"
+                    f"{r['p95_ms']:>10}{r['p99_ms']:>10}{r['mean_ms']:>10}"
+                    f"   n={r['jobs']}"
+                )
+        fd = out.get("frontdoor", {})
+        if fd:
+            c = fd.get("cache", {})
+            print(
+                f"  frontdoor: routes={fd.get('routes')} cache_hits={c.get('hits')}"
+                f" canonical_dups={c.get('canonical_dups')}"
+                f" native_fallback_wins={fd.get('native_fallback_wins')}"
+            )
 
 
 if __name__ == "__main__":

@@ -22,6 +22,12 @@ Likewise the artifact's ``compile`` section (bench_poisson's
 obs/compilewatch accounting): a cold-cache side is *labeled* — its
 quantiles include compile noise, and a cold-vs-warm compare earns an
 explicit "re-run warm" note instead of hiding inside the band.
+
+Mixed-corpus artifacts (``bench_poisson --mix``, round 17) are only
+comparable to artifacts with the *identical* mix: the overall quantiles
+blend cache/native/device routes in mix-specific proportions, so a
+cross-mix compare is a different workload (**exit 2**), not a
+regression.
 """
 
 from __future__ import annotations
@@ -57,11 +63,26 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
                 f"expected {SCHEMA}"
             )
     if not errors and old.get("params") != new.get("params"):
-        errors.append(
-            "artifacts measured different workloads: "
-            f"params {old.get('params')} vs {new.get('params')} — "
-            "re-run both sides with identical flags"
-        )
+        om = (old.get("params") or {}).get("mix")
+        nm = (new.get("params") or {}).get("mix")
+        if om != nm:
+            # A mixed-difficulty corpus (bench_poisson --mix) measures a
+            # DIFFERENT workload: its quantiles blend cache/native/device
+            # routes in mix-specific proportions, so a cross-mix compare
+            # is apples-to-oranges — refuse (exit 2), never call it a
+            # regression.  Pre-round-17 artifacts carry no mix key and
+            # compare as the all-hard corpus (mix=None).
+            errors.append(
+                f"artifacts measured different corpus mixes: {om!r} vs "
+                f"{nm!r} — a --mix artifact is only comparable to an "
+                "artifact with the identical mix"
+            )
+        else:
+            errors.append(
+                "artifacts measured different workloads: "
+                f"params {old.get('params')} vs {new.get('params')} — "
+                "re-run both sides with identical flags"
+            )
     if errors:
         return {
             "comparable": False,

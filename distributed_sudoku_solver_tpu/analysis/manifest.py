@@ -107,6 +107,17 @@ LAYERS = {
     "parallel": {"closed": False, "forbid": ("serving", "cluster", "analysis")},
     "utils": {"closed": False, "forbid": ("serving", "cluster", "analysis")},
     "native": {"closed": False, "forbid": ("serving", "cluster", "analysis")},
+    # The front door (serving/frontdoor, ISSUE 14) is a closed layer:
+    # host-side stdlib + numpy compute (canonical.py has NO jax — the
+    # whole point is answering without a device), the obs planes, its
+    # serving siblings (portfolio's race_native seam; engine's Job), the
+    # geometry model, and the native DFS.  Never cluster: routing happens
+    # per node, and cache state is deliberately node-local.
+    "serving.frontdoor": {
+        "closed": True,
+        "allow": ("serving", "obs", "models.geometry", "native"),
+        "third_party": ("numpy",),
+    },
     # serving sits BELOW cluster (cluster/node.py imports serving.engine):
     # a serving -> cluster import would be a cycle by construction.
     "serving": {"closed": False, "forbid": ("cluster",)},
@@ -528,6 +539,18 @@ LOCK_RANKS = {
     "serving.injector": 40,   # serving/faults.py FaultInjector._lock
     "serving.control": 42,    # serving/engine.py _Control.lock (dataclass field)
     "cluster.dedupe": 44,     # cluster/node.py _DedupeLRU._lock
+    # The front door's two locks sit above the serving coordination
+    # locks: route() runs on submit/handler threads holding nothing, and
+    # the device loop's cache-fill hook (engine._finish_job ->
+    # FrontDoor._device_resolved) may run under a flight lock — counter
+    # bookkeeping (router, 45) and the LRU store (cache, 46) must both
+    # be acquirable there.  Router before cache so a future "count under
+    # the router lock while filling the store" nesting is legal; today
+    # neither is ever held into the other.
+    "frontdoor.router": 45,   # serving/frontdoor/router.py FrontDoor._lock
+    "frontdoor.cache": 46,    # serving/frontdoor/cache.py ResultCache._lock
+    "frontdoor.race": 47,     # serving/portfolio.py race_native settle lock
+    #   (winner claim only — never held into another acquisition)
     "native.build": 50,       # native/__init__.py _lock (libcsp build)
     "utils.profile_window": 52,  # utils/profiling.py _window_lock
     "obs.compilewatch": 60,   # obs/compilewatch.py CompileWatch._lock
@@ -568,6 +591,11 @@ LOCK_EDGE_DECLARED = {
         "serving.scheduler",
         "serving.breaker",
         "serving.injector",
+        # engine.metrics also reads the front-door counters/cache
+        # metrics when a front door is installed (round 17) — same
+        # injected-callable closure, same rank-upward legality.
+        "frontdoor.router",
+        "frontdoor.cache",
         "obs.compilewatch",
         "obs.critpath",
         "obs.trace",
@@ -600,6 +628,9 @@ DEADCK_BASE_CLASSES = {
     "rec": ("obs/trace.py", "TraceRecorder"),
     "cw": ("obs/compilewatch.py", "CompileWatch"),
     "cp": ("obs/critpath.py", "CritPathMonitor"),
+    "self.frontdoor": ("serving/frontdoor/router.py", "FrontDoor"),
+    "self.cache": ("serving/frontdoor/cache.py", "ResultCache"),
+    "fd": ("serving/frontdoor/router.py", "FrontDoor"),
 }
 
 # The repo's thread roots: qualname prefixes (per file) whose bodies run

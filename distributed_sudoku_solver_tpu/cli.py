@@ -88,6 +88,31 @@ def build_parser() -> argparse.ArgumentParser:
         "429 + Retry-After",
     )
     ap.add_argument(
+        "--no-frontdoor",
+        action="store_true",
+        help="bypass the front door (serving/frontdoor): no symmetry-"
+        "canonical result cache, no propagation probe, no native "
+        "routing — every /solve pays the direct engine path, as before "
+        "round 17",
+    )
+    ap.add_argument(
+        "--cache-entries",
+        type=int,
+        default=65536,
+        help="front-door result-cache capacity (canonical entries; LRU "
+        "beyond it).  An entry is one solved or proven-unsat orbit — "
+        "every symmetry-equivalent resubmission answers from it",
+    )
+    ap.add_argument(
+        "--easy-score",
+        type=int,
+        default=64,
+        help="front-door difficulty threshold: boards whose post-"
+        "propagation branching slack (sum of candidates-1 over undecided "
+        "cells) is at or below this race the native DFS instead of "
+        "paying a device dispatch",
+    )
+    ap.add_argument(
         "--fault-retries",
         type=int,
         default=3,
@@ -268,6 +293,19 @@ def make_engine(args) -> SolverEngine:
         )
     from distributed_sudoku_solver_tpu.serving.faults import RecoveryPolicy
 
+    frontdoor = None
+    if not args.no_frontdoor:
+        # The front door (serving/frontdoor) is the default routing layer
+        # for POST /solve: canonical result cache, propagation probe,
+        # native routing for the easy tier (ISSUE 14 / ROADMAP #3).
+        from distributed_sudoku_solver_tpu.serving.frontdoor.router import (
+            FrontDoorConfig,
+        )
+
+        frontdoor = FrontDoorConfig(
+            cache_entries=args.cache_entries,
+            easy_score=args.easy_score,
+        )
     return SolverEngine(
         config=cfg,
         max_batch=args.max_batch,
@@ -280,6 +318,7 @@ def make_engine(args) -> SolverEngine:
             breaker_failures=args.breaker_failures,
             breaker_cooldown_s=args.breaker_cooldown,
         ),
+        frontdoor=frontdoor,
     )
 
 

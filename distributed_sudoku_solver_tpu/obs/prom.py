@@ -51,6 +51,10 @@ _LABEL_DICTS = {
     # per-program series label by display name instead of minting one
     # metric family per compiled program.
     "programs": "program",
+    # Front-door route counters (serving/frontdoor): one series per
+    # routing tier (cache/propagation/native/device) under a `route`
+    # label, mirroring the frontdoor_<route>_ms histograms in `hist`.
+    "routes": "route",
 }
 
 

@@ -155,6 +155,23 @@ class Job:
     # on the RECORDER's clock (virtual in simnet tests) — `submitted_at`
     # stays on the wall clock for latency/deadline semantics.
     trace_t0: Optional[float] = None
+    # Front-door routing (serving/frontdoor): which tier answered —
+    # 'cache' | 'propagation' | 'native' | 'device' — or None for jobs
+    # that never crossed the front door.
+    route: Optional[str] = None
+    # Resolution hook: called by _finish_job with the verdict fields set,
+    # BEFORE the done event (the front door's cache fill — a waiter that
+    # resubmits the moment it wakes must see the entry).  Exceptions are
+    # logged, never propagated; the hook fires at most once.
+    on_resolve: Optional[Callable[["Job"], None]] = None
+    # Shadow jobs are accounting-invisible: _finish_job still resolves
+    # them (verdict fields, trace event, hooks, done) but skips every
+    # counter/histogram/SLO sample.  The portfolio native race submits
+    # its device FALLBACK as a shadow — the one user request is accounted
+    # exactly once, by the race's own verdict hook, whichever entrant
+    # wins (a non-shadow fallback double-counted the request the moment
+    # the native entrant won after the fallback had been submitted).
+    shadow: bool = False
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -228,6 +245,7 @@ class SolverEngine:
         resident=None,  # Optional[serving.scheduler.ResidentConfig]
         recovery: Optional[faults.RecoveryPolicy] = None,
         clock: Callable[[], float] = time.monotonic,
+        frontdoor=None,  # Optional[serving.frontdoor.FrontDoorConfig]
     ):
         self.config = config
         self.max_batch = max_batch
@@ -295,6 +313,13 @@ class SolverEngine:
                 "event_wall_ms",
                 "admission_wait_ms",
                 "chunk_wall_ms",
+                # Per-route front-door latencies (serving/frontdoor):
+                # empty (and therefore absent from /metrics and the
+                # cluster rollup) unless a front door is installed.
+                "frontdoor_cache_ms",
+                "frontdoor_propagation_ms",
+                "frontdoor_native_ms",
+                "frontdoor_device_ms",
             )
         }
         # Live RPC-floor estimate from the chunk.sync samples (both serving
@@ -372,6 +397,17 @@ class SolverEngine:
         # this to its wire address so a stitched multi-node trace
         # attributes each engine span to the host that recorded it.
         self.trace_node: Optional[str] = None
+        # The front door (serving/frontdoor, ISSUE 14): symmetry-canonical
+        # result cache + difficulty-probed routing ahead of every eligible
+        # submit.  Built last so it sees a fully-wired engine; lazy import
+        # keeps the frontdoor package out of engine-only deployments.
+        self.frontdoor = None
+        if frontdoor is not None:
+            from distributed_sudoku_solver_tpu.serving.frontdoor.router import (
+                FrontDoor,
+            )
+
+            self.frontdoor = FrontDoor(self, frontdoor)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "SolverEngine":
@@ -398,11 +434,23 @@ class SolverEngine:
         config: Optional[SolverConfig] = None,
         deadline_s: Optional[float] = None,
         saturation: str = "fallback",
+        frontdoor: bool = True,
+        shadow: bool = False,
     ) -> Job:
-        """Enqueue one job.  Eligible jobs (no per-job config, no roots,
-        engine not enumerating) route into the geometry's resident flight
-        when one is configured (``serving/scheduler.py``); the rest take
-        the static flight path.  ``saturation`` picks the policy when the
+        """Enqueue one job.  With a front door installed
+        (``SolverEngine(frontdoor=...)``), eligible jobs cross it first:
+        canonical-cache hits and propagation-solved/unsat boards come
+        back already resolved, easy boards race the native DFS
+        (``serving/portfolio.race_native``), and only the hard tail
+        reaches a device flight — ``frontdoor=False`` is the per-call
+        bypass (the racer's own fallback submit, bulk stragglers, tests
+        pinning the direct path).  Per-job configs (portfolio racers,
+        ``count_all``) skip the seam by construction.
+
+        Eligible device-path jobs (no per-job config, no roots, engine
+        not enumerating) route into the geometry's resident flight when
+        one is configured (``serving/scheduler.py``); the rest take the
+        static flight path.  ``saturation`` picks the policy when the
         resident admission queue is full: ``'fallback'`` (default) quietly
         uses a static flight, ``'reject'`` raises ``EngineSaturated`` — the
         HTTP layer's 429 + Retry-After backpressure."""
@@ -411,7 +459,8 @@ class SolverEngine:
         if g.shape != (geom.n, geom.n):
             raise ValueError(f"grid shape {g.shape} does not match geometry {geom}")
         job = Job(
-            uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom, config=config
+            uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom,
+            config=config, shadow=shadow,
         )
         # Re-stamp on the ENGINE clock: the dataclass default factory is
         # the real monotonic clock, which is only the same time source
@@ -422,9 +471,29 @@ class SolverEngine:
             job.trace_t0 = rec.now()
         if deadline_s is not None:
             job.deadline = job.submitted_at + deadline_s
-        if self._route_resident(job, saturation):
-            return job
-        self._enqueue(job)
+        fd_token = None
+        fd_routed = False
+        if (
+            frontdoor
+            and self.frontdoor is not None
+            and config is None
+            and not self.config.count_all
+            and not shadow  # the race's fallback must not re-enter the door
+        ):
+            # The front door owns cache/propagation/native verdicts;
+            # owned=False means "hard tail" — fall through to the device
+            # paths below, then COMMIT the routing decision (counters,
+            # cache-fill registration) only once placement succeeded, so
+            # an EngineSaturated 429 never inflates the device-route
+            # counters or parks a dead cache-fill entry.
+            owned, fd_token = self.frontdoor.route(job)
+            if owned:
+                return job
+            fd_routed = True
+        if not self._route_resident(job, saturation):
+            self._enqueue(job)
+        if fd_routed:
+            self.frontdoor.commit_device(job, fd_token)
         return job
 
     def _route_resident(self, job: Job, saturation: str) -> bool:
@@ -614,11 +683,17 @@ class SolverEngine:
             return [rf for rf in self._resident.values() if rf is not None]
 
     def stats(self) -> dict:
-        return {
+        s = {
             "validations": int(self.validations),
             "solved": int(self.solved_count),
             "jobs_done": int(self.jobs_done),
         }
+        if self.frontdoor is not None:
+            # Jobs the front door answered without a device flight still
+            # count as this node's work (native-racer nodes land in
+            # `validations`, matching the reference's counter semantics).
+            s = self.frontdoor.merge_stats(s)
+        return s
 
     def metrics(self) -> dict:
         """Extended observability (GET /metrics): latency percentiles over
@@ -679,6 +754,13 @@ class SolverEngine:
             }
         if self.resident_unfit:
             out["resident_unfit"] = int(self.resident_unfit)
+        if self.frontdoor is not None:
+            # The routing layer's own observability (serving/frontdoor):
+            # cache hit/miss/eviction/canonical-dup counters, probe
+            # verdicts, per-route dispatch counts.  The matching per-route
+            # latency histograms ride the `hist` section below, so the
+            # cluster rollup merges them for free.
+            out["frontdoor"] = self.frontdoor.metrics()
         # Self-healing observability (serving/faults.py): retry/requeue/
         # downgrade/bisection counters, per-geometry breaker state, and —
         # when a fault injector is installed — what it injected where.
@@ -1479,6 +1561,22 @@ class SolverEngine:
             self._finish_job(job)
 
     def _finish_job(self, job: Job) -> None:
+        if job.shadow:
+            # Accounting-invisible resolution (see Job.shadow): verdict
+            # fields are already set; fire the hook and release waiters,
+            # touch no counter/histogram/SLO — the race that submitted
+            # this job accounts the user's ONE request itself.
+            cb = job.on_resolve
+            if cb is not None:
+                job.on_resolve = None
+                try:
+                    cb(job)
+                except Exception:  # noqa: BLE001
+                    _LOG.exception(
+                        "[engine] on_resolve hook failed for %s", job.uuid
+                    )
+            job.done.set()
+            return
         wall = self._clock() - job.submitted_at
         self.latency.record(wall)
         if job.solved:
@@ -1511,6 +1609,19 @@ class SolverEngine:
             cp = critpath.active()
             if cp is not None:
                 cp.observe_job(job.uuid, wall)
+        cb = job.on_resolve
+        if cb is not None:
+            # Front-door cache fill (serving/frontdoor): runs with the
+            # verdict fields set but BEFORE the done event, so a waiter
+            # that resubmits immediately sees the entry.  At most once,
+            # and never allowed to kill resolution.
+            job.on_resolve = None
+            try:
+                cb(job)
+            except Exception:  # noqa: BLE001
+                _LOG.exception(
+                    "[engine] on_resolve hook failed for %s", job.uuid
+                )
         job.done.set()
 
     # -- control requests (snapshot / shed) ----------------------------------

@@ -1,0 +1,99 @@
+"""Bounded content-addressed result cache keyed on canonical digests.
+
+Values are verdicts in the CANONICAL frame: a solved entry stores the
+canonical solution (mapped back to each requester's frame via that
+request's own inverse transform — the entry itself is frame-free), an
+unsat entry stores the negative verdict (proven unsatisfiability is an
+orbit property, so one proof answers every equivalent board).  Overflowed
+or errored searches are never cached: no verdict, no entry.
+
+``lookup_entry``/``store_entry`` (named to stay unique in the repo's
+method vocabulary — deadck resolves cross-module calls by name) do LRU
+bookkeeping under a single deadck-ranked lock (``frontdoor.cache``,
+acquired by HTTP handler threads at lookup, the device loop at device-
+verdict insert, and the portfolio native-racer threads at native-verdict
+insert; it nests inside the engine/scheduler locks rank-upward and holds
+nothing further).  All counters are lockck-guarded.  Stdlib + numpy only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from distributed_sudoku_solver_tpu.obs import lockdep
+
+#: Verdicts an entry may carry (``unsat`` entries are the negative form).
+SOLVED, UNSAT = "solved", "unsat"
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheEntry:
+    verdict: str  # SOLVED | UNSAT
+    solution: Optional[np.ndarray]  # int8[n, n] canonical frame; None for UNSAT
+    nodes: int  # the original search's expanded nodes (stats parity)
+    raw_digest: str  # digest of the submitted board that FILLED the entry
+    #   (a later hit from a different representative is a canonical dup)
+    route: str  # which tier produced the verdict (propagation/native/device)
+
+
+class ResultCache:
+    """Bounded LRU store: canonical digest -> :class:`CacheEntry`."""
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = max(1, int(capacity))
+        self._lock = lockdep.named_lock("frontdoor.cache")  # lockck: name(frontdoor.cache)
+        self._entries: "OrderedDict[str, CacheEntry]" = OrderedDict()
+        self.hits = 0  # lockck: guard(_lock)
+        self.negative_hits = 0  # lockck: guard(_lock) — hits answered from an UNSAT entry
+        self.misses = 0  # lockck: guard(_lock)
+        self.evictions = 0  # lockck: guard(_lock)
+        self.insertions = 0  # lockck: guard(_lock)
+        self.canonical_dups = 0  # lockck: guard(_lock) — hits whose submitted
+        #   board differed from the entry's filler (a symmetry-transformed repeat)
+
+    def lookup_entry(self, digest: str, raw_digest: str) -> Optional[CacheEntry]:
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(digest)
+            self.hits += 1
+            if entry.verdict == UNSAT:
+                self.negative_hits += 1
+            if entry.raw_digest != raw_digest:
+                self.canonical_dups += 1
+            return entry
+
+    def store_entry(self, digest: str, entry: CacheEntry) -> None:
+        with self._lock:
+            if digest not in self._entries and len(self._entries) >= self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            # Last write wins on a racing double-fill of the same orbit:
+            # both verdicts are correct (solutions of a unique puzzle are
+            # equal in any frame), so there is nothing to reconcile.
+            self._entries[digest] = entry
+            self._entries.move_to_end(digest)
+            self.insertions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "hits": int(self.hits),
+                "negative_hits": int(self.negative_hits),
+                "misses": int(self.misses),
+                "evictions": int(self.evictions),
+                "insertions": int(self.insertions),
+                "canonical_dups": int(self.canonical_dups),
+            }

@@ -8,11 +8,29 @@ DHT_Node.py:540-614`` (SudokuHandler):
                            "nodes": [{"address": "h:p", "validations": V}, ...]}
 * ``GET /network`` -> 200 {"<addr>": ["<predecessor>", "<successor>"], ...}
 
+Since round 17 a **front door** (``serving/frontdoor``) sits on the
+engine's submit seam ahead of every plain ``POST /solve``: a
+symmetry-canonical result cache (any of the ~3x10^6 equivalents of a
+published puzzle answers from one entry, O(µs) after the host-side
+canonicalization), a propagation-only difficulty probe that answers
+propagation-solved boards and proven-contradictory boards (422) without
+any dispatch, and difficulty routing (easy boards race the native DFS
+via ``serving/portfolio.race_native``; the hard tail runs
+resident/static flights exactly as before).  CLI knobs:
+``--no-frontdoor`` restores the direct path, ``--cache-entries`` bounds
+the result cache.  **Bypasses**: ``count_all``, ``portfolio``, and
+``POST /solve_batch`` requests never touch the cache — enumeration and
+bulk are not memoizable by a single canonical entry, and portfolio
+racers carry per-job configs, which skip the seam by construction.
+
 Superset endpoints (absent from the reference):
 
 * ``GET /metrics`` — latency percentiles, batch sizes, fault/breaker
-  counters, mergeable phase histograms (``hist`` section, obs/hist.py),
-  the live ``rpc_floor_ms`` estimate, device info.  Since round 8 the
+  counters, mergeable phase histograms (``hist`` section, obs/hist.py —
+  including the front door's per-route ``frontdoor_*_ms`` latency
+  histograms), the ``frontdoor`` section (cache hits/misses/evictions,
+  canonical-dup counts, probe verdicts, per-route dispatch counts), the
+  live ``rpc_floor_ms`` estimate, device info.  Since round 8 the
   flight-loop wall is split into ``dispatch_wall_ms`` (host time
   enqueueing device work — async, near zero), ``sync_wall_ms`` (host
   time blocked in the one per-chunk status fetch), and
@@ -408,9 +426,12 @@ class _Handler(BaseHTTPRequestHandler):
         # Stragglers (step cap hit) become ordinary engine jobs: they share
         # the chunked flight loop fairly with interactive traffic and stay
         # individually cancellable, instead of monopolizing the device
-        # inside one long exclusive section.
+        # inside one long exclusive section.  frontdoor=False: solve_batch
+        # is documented to bypass the result cache wholesale (bulk is not
+        # memoizable by a single canonical entry), so its stragglers must
+        # not be the one path that quietly populates it.
         pending = [
-            (int(i), engine.submit(grids[i], geom=geom))
+            (int(i), engine.submit(grids[i], geom=geom, frontdoor=False))
             for i in np.flatnonzero(~solved & ~unsat)
         ]
         for i, job in pending:
@@ -714,7 +735,16 @@ class StandaloneNode:
     """
 
     def __init__(self, engine: Optional[SolverEngine] = None, address: str = "local:0"):
-        self.engine = engine or SolverEngine().start()
+        if engine is None:
+            # The front door is the default routing layer for a serving
+            # node (ISSUE 14); callers supplying their own engine choose
+            # their own frontdoor= policy.
+            from distributed_sudoku_solver_tpu.serving.frontdoor.router import (
+                FrontDoorConfig,
+            )
+
+            engine = SolverEngine(frontdoor=FrontDoorConfig()).start()
+        self.engine = engine
         self.address = address
 
     def submit(self, grid):

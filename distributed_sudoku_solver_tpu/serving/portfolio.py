@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import threading
 import time
 from typing import Callable, Optional, Sequence
@@ -27,6 +28,7 @@ from typing import Callable, Optional, Sequence
 import jax
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.obs import lockdep
 from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
 from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
 
@@ -114,6 +116,160 @@ def race_jobs(
         duration_s=clock() - start,
         timed_out=timed_out,
     )
+
+
+_LOG = logging.getLogger(__name__)
+
+
+def race_native(
+    engine: SolverEngine,
+    job: Job,
+    head_start_s: float = 0.5,
+    on_verdict: Optional[Callable[[Job], None]] = None,
+) -> Job:
+    """Race the native C++ DFS against a *delayed* device fallback on one
+    pre-built job — the find-one twin of :func:`race_cover`, and the seam
+    the front door's easy tier routes through (``serving/frontdoor``).
+
+    First verdict wins, same contract as :func:`race_jobs`:
+
+    * **native**: ``native.solve`` on a daemon thread — the measured
+      winner on easy boards, where the device path's dispatch floor
+      dwarfs the search itself.  A native *decline* (no compiler,
+      malformed grid) releases the fallback immediately.  Like the cover
+      race, a losing native entrant cannot be interrupted mid-recursion:
+      it finishes in the background and its verdict is discarded.
+    * **device fallback**: waits ``head_start_s`` (or the native
+      entrant's settle, whichever first), then submits the board to the
+      engine as a *shadow* job (accounting-invisible — the race's hook
+      counts the one user request exactly once whichever entrant wins)
+      under the SAME uuid — so a caller-side ``engine.cancel(job.uuid)``
+      (the HTTP timeout path) reaches the fallback flight — bypassing
+      the front door (``frontdoor=False``: the race IS the front door's
+      native tier; re-entering it would loop) and inheriting the outer
+      job's absolute deadline.  A native win mid-flight cancels the
+      fallback; a fallback resolution (including a cancellation or an
+      expired deadline) resolves the job if the native entrant has not
+      already.
+
+    ``on_verdict`` (the front door's accounting + cache-fill hook) runs
+    on the winning entrant's thread for EVERY resolution, with the
+    verdict fields set, BEFORE the job's done event — a waiter that
+    resubmits the moment it wakes sees the cache already filled.
+    ``job.route`` tells it which entrant won ('native' or 'device').  No
+    clock reads here: the deadline/latency math belongs to the caller,
+    and the head start is a bounded ``Event.wait`` yield (the
+    simnet-blessed idiom).
+    """
+    # The settle lock guards ONLY the winner claim: the claiming thread
+    # then fills the job, runs the verdict hook, and sets the done event
+    # lock-free (single writer; the event's set is the release barrier),
+    # so no other lock is ever acquired under it — it stays a leaf in the
+    # deadck hierarchy whatever the hook touches.
+    settle = lockdep.named_lock("frontdoor.race")  # lockck: name(frontdoor.race)
+    claimed = [False]
+    native_settled = threading.Event()
+    device_submitted = threading.Event()
+
+    def _finish(route, solved=False, solution=None, unsat=False, nodes=0,
+                error=None, cancelled=False) -> bool:
+        import numpy as np
+
+        with settle:
+            if claimed[0]:
+                return False
+            claimed[0] = True
+        job.route = route
+        job.solved = bool(solved)
+        job.unsat = bool(unsat)
+        # unsat here is always a COMPLETE proof (the native DFS ran its
+        # space dry, or the device fallback's own exhaustion): mirror it
+        # on `exhausted`, the field cluster finalization actually reads.
+        job.exhausted = bool(unsat)
+        job.cancelled = bool(cancelled)
+        job.solution = (
+            None if solution is None
+            else np.asarray(solution, np.int32)  # syncck: allow(native DFS result — ctypes host array, no device value)
+        )
+        job.nodes = int(nodes)
+        job.error = error
+        if on_verdict is not None:
+            # EVERY resolution fires the hook — the fallback runs as an
+            # accounting-invisible shadow job, so this call is the one
+            # place the request gets counted (the hook's cache fill
+            # guards cancels/errors itself).
+            try:
+                on_verdict(job)
+            except Exception:  # noqa: BLE001 - cache fill must not kill the race
+                _LOG.exception(
+                    "[portfolio] race_native verdict hook failed (%s)",
+                    job.uuid,
+                )
+        job.done.set()
+        return True
+
+    def native_entrant() -> None:
+        won = False
+        try:
+            try:
+                from distributed_sudoku_solver_tpu import native
+
+                if not native.available():
+                    return  # decline: the fallback covers it
+                sol, nodes = native.solve(job.grid, job.geom)
+            except Exception:  # noqa: BLE001 - any native failure is a decline
+                return
+            won = _finish(
+                "native", solved=sol is not None, solution=sol,
+                unsat=sol is None, nodes=nodes,
+            )
+        finally:
+            native_settled.set()
+        if won and device_submitted.is_set():
+            engine.cancel(job.uuid)  # release the fallback flight
+
+    def device_entrant() -> None:
+        native_settled.wait(head_start_s)
+        if job.done.is_set():
+            return  # native already answered inside its head start
+        try:
+            # shadow=True: the fallback is accounting-invisible in the
+            # engine (the race's verdict hook counts the ONE request);
+            # sharing the outer uuid lets caller-side cancels reach it.
+            inner = engine.submit(
+                job.grid, geom=job.geom, job_uuid=job.uuid,
+                frontdoor=False, shadow=True,
+            )
+        except Exception as e:  # noqa: BLE001 - engine stopped/rejecting
+            native_settled.wait()  # the native entrant always settles
+            if not job.done.is_set():
+                _finish("device", error=f"device fallback unavailable: {e}")
+            return
+        # The caller's wall-clock budget survives the hop: the fallback
+        # inherits the outer job's absolute deadline (chunk-granularity
+        # enforcement reads it per pass, so setting it post-submit is at
+        # worst one chunk late — the documented reaction lag).  The
+        # native entrant itself is uninterruptible; an expired fallback
+        # resolving "deadline expired" is what bounds the caller's wait.
+        if job.deadline is not None:
+            inner.deadline = job.deadline
+        device_submitted.set()
+        if job.done.is_set():
+            engine.cancel(job.uuid)  # native won during our submit window
+        inner.done.wait()
+        _finish(
+            "device", solved=inner.solved, solution=inner.solution,
+            unsat=inner.unsat, nodes=inner.nodes, error=inner.error,
+            cancelled=inner.cancelled,
+        )
+
+    threading.Thread(
+        target=native_entrant, daemon=True, name="frontdoor-native"
+    ).start()
+    threading.Thread(
+        target=device_entrant, daemon=True, name="frontdoor-native-fallback"
+    ).start()
+    return job
 
 
 #: Include the native C++ DFS as a cover-race entrant only below this row

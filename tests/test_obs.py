@@ -318,6 +318,14 @@ PROM_SAMPLE = {
             "sum_ms": 3105.2,
             "exemplars": {"11": "1f2e3d4c"},
         },
+        # A front-door per-route latency histogram (round 17): rides the
+        # same mergeable keyspace as every other phase histogram.
+        "frontdoor_cache_ms": {
+            "type": "log2_hist",
+            "edge0_ms": 0.001,
+            "counts": [0] * 11 + [7, 5] + [0] * 19,
+            "sum_ms": 51.75,
+        },
     },
     "rpc_floor_ms": {"type": "min_est", "min": 48.9, "recent": 50.2,
                      "samples": 210},
@@ -383,6 +391,27 @@ PROM_SAMPLE = {
             "achieved_gflops_per_s": 0.046917,
         },
     },
+    # Round-17 front-door section (serving/frontdoor): route counters as
+    # a `route`-labeled table, cache hit/miss/eviction/canonical-dup
+    # counters, probe verdicts, and the availability/fallback gauges.
+    "frontdoor": {
+        "routes": {"cache": 12, "propagation": 30, "native": 5, "device": 3},
+        "probe": {"solved": 28, "unsat": 2, "easy": 5, "hard": 3},
+        "uncacheable": 1,
+        "native_available": True,
+        "native_fallback_wins": 0,
+        "pending_fills": 2,
+        "cache": {
+            "entries": 4,
+            "capacity": 65536,
+            "hits": 12,
+            "negative_hits": 1,
+            "misses": 38,
+            "evictions": 0,
+            "insertions": 9,
+            "canonical_dups": 9,
+        },
+    },
     "critpath": {
         "jobs": 12,
         "attribution_ms": {
@@ -440,26 +469,50 @@ def test_prometheus_sample_passes_promck():
 
 def test_promck_over_live_prometheus_endpoint():
     """Satellite: the LIVE ``GET /metrics?format=prometheus`` body — with
-    the histogram sections populated by a real solve and the round-15
-    compile/cost/critpath planes installed — passes promck."""
+    the histogram sections populated by a real solve, the round-15
+    compile/cost/critpath planes installed, AND the round-17 front door
+    routing real traffic (a device-routed hard board, a propagation-
+    answered easy board, and a symmetry-transformed cache hit) — passes
+    promck and carries the frontdoor families."""
     import urllib.request
 
+    import numpy as np
+
     from distributed_sudoku_solver_tpu.obs import compilewatch, critpath, promck
+    from distributed_sudoku_solver_tpu.serving.frontdoor.canonical import (
+        apply_transform,
+        random_transform,
+    )
+    from distributed_sudoku_solver_tpu.serving.frontdoor.router import (
+        FrontDoorConfig,
+    )
     from distributed_sudoku_solver_tpu.serving.http import (
         ApiServer,
         StandaloneNode,
     )
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
 
     rec = trace.TraceRecorder(ring=4096)
     watch = compilewatch.CompileWatch(warmup_s=3600.0)
     mon = critpath.CritPathMonitor()
-    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=4).start()
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, chunk_steps=4,
+        frontdoor=FrontDoorConfig(),
+    ).start()
     api = ApiServer(StandaloneNode(eng), host="127.0.0.1", port=0).start()
     try:
         with trace.installed(rec), compilewatch.installed(watch), \
                 critpath.installed(mon):
-            j = eng.submit(HARD_9[1])
+            j = eng.submit(HARD_9[1])  # hard tail: device route
             assert j.wait(120) and j.solved, j.error
+            je = eng.submit(np.asarray(EASY_9))  # propagation route
+            assert je.wait(30) and je.solved and je.route == "propagation"
+            transformed = apply_transform(
+                HARD_9[1], random_transform(SUDOKU_9, np.random.default_rng(5))
+            )
+            jc = eng.submit(transformed)  # symmetry-canonical cache hit
+            assert jc.wait(30) and jc.solved and jc.route == "cache"
             raw = (
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{api.port}/metrics?format=prometheus",
@@ -485,6 +538,16 @@ def test_promck_over_live_prometheus_endpoint():
     assert "dsst_cost_efficiency_achieved_gflops_per_s" in raw
     assert "dsst_critpath_jobs" in raw
     assert 'dsst_hist_critpath_sync_ms_bucket{le="+Inf"}' in raw
+    # Round-17 front-door families: route counters under the `route`
+    # label, cache counters (the transformed resubmit is both a hit and
+    # a canonical dup), and the per-route latency histograms in `hist`.
+    assert 'dsst_frontdoor_routes{route="device"} 1' in raw
+    assert 'dsst_frontdoor_routes{route="cache"} 1' in raw
+    assert 'dsst_frontdoor_routes{route="propagation"} 1' in raw
+    assert "dsst_frontdoor_cache_hits 1" in raw
+    assert "dsst_frontdoor_cache_canonical_dups 1" in raw
+    assert 'dsst_hist_frontdoor_cache_ms_bucket{le="+Inf"} 1' in raw
+    assert 'dsst_hist_frontdoor_device_ms_bucket{le="+Inf"} 1' in raw
 
 
 # -- simnet acceptance ---------------------------------------------------------
