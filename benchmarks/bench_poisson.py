@@ -32,6 +32,14 @@ Run: ``python benchmarks/bench_poisson.py [--jobs 48] [--mean-ms 50]
 [--handicap-ms 50] [--json]``.  The tier-1 smoke and the ``slow``-marked
 assertion live in ``tests/test_scheduler.py``.
 
+``--workload-out trace.json`` (round 18) records the resident run as a
+versioned workload trace (``dsst-workload/1``: arrival offsets, board
+payloads, per-job tier/route/verdict/wall) that ``benchmarks/replay.py``
+re-runs through ``cluster/simnet.py`` as a deterministic, sleep-free
+capacity experiment — with the brownout controller live — whose
+``dsst-replay/1`` artifact ``benchmarks/regress.py`` can compare against
+a live ``--out-json`` run.
+
 ``--mix easy:N,hard:M,repeat:R`` (round 17) swaps the all-hard corpus
 for a realistic mixed-difficulty stream — distinct easy and hard boards
 plus *symmetry-transformed* repeats of already-sent ones — and runs both
@@ -69,12 +77,31 @@ def _percentiles(lats) -> dict:
     }
 
 
+def poisson_gaps(n_boards: int, mean_gap_s: float, seed: int = 0) -> list:
+    """The deterministic inter-arrival schedule (same draw order as the
+    pre-round-18 inline draws, so seeded runs reproduce byte-identically):
+    ``n_boards - 1`` exponential gaps.  Shared by :func:`poisson_load`
+    and the workload-trace recorder, so a recorded trace's arrival
+    offsets are exactly the schedule the live run fired."""
+    rng = random.Random(seed)
+    return [rng.expovariate(1.0 / mean_gap_s) for _ in range(max(0, n_boards - 1))]
+
+
+def arrival_offsets(n_boards: int, mean_gap_s: float, seed: int = 0) -> list:
+    """Cumulative arrival offsets (seconds from the first submit) of the
+    :func:`poisson_gaps` schedule."""
+    offsets = [0.0]
+    for g in poisson_gaps(n_boards, mean_gap_s, seed):
+        offsets.append(offsets[-1] + g)
+    return offsets[:n_boards]
+
+
 def poisson_load(engine, boards, mean_gap_s: float, seed: int = 0,
                  timeout: float = 600.0):
     """Submit ``boards`` with exponential inter-arrival gaps; returns
     ``(latencies_s, jobs)`` where latency is submit -> resolution wall
     (inf for a job that missed ``timeout``)."""
-    rng = random.Random(seed)
+    gaps = poisson_gaps(len(boards), mean_gap_s, seed)
     jobs: list = []
     lats = [float("inf")] * len(boards)
     threads = []
@@ -90,7 +117,7 @@ def poisson_load(engine, boards, mean_gap_s: float, seed: int = 0,
         t.start()
         threads.append(t)
         if i + 1 < len(boards):
-            time.sleep(rng.expovariate(1.0 / mean_gap_s))
+            time.sleep(gaps[i])
     for t in threads:
         t.join(timeout)
     return lats, jobs
@@ -118,6 +145,14 @@ def parse_mix(spec: str) -> dict:
     if sum(mix.values()) < 1:
         raise SystemExit("--mix needs at least one board")
     return mix
+
+
+def _mix_spec(mix: dict) -> str:
+    """Canonical spelling of a mix-counts dict (``easy:N,hard:M,repeat:R``
+    in fixed order) — workload traces store this normalized form so
+    regress.py can compare it against a live artifact's raw ``--mix``
+    string whatever order the operator typed."""
+    return f"easy:{mix['easy']},hard:{mix['hard']},repeat:{mix['repeat']}"
 
 
 def mixed_corpus(mix: dict, seed: int):
@@ -192,6 +227,7 @@ def compare_poisson(
     seed: int = 7,
     chunk_steps: int = 8,
     mix: Optional[dict] = None,
+    record_workload: bool = False,
 ) -> dict:
     """One A/B: identical arrival schedule against a static-flight engine
     and a resident-flight engine (same solver config, same chunk
@@ -204,6 +240,13 @@ def compare_poisson(
     percentiles land beside the overall numbers: cache/native routes
     never pay the handicapped device fetch seam, so no dispatch floor
     applies to them.
+
+    ``record_workload=True`` captures the RESIDENT run (the production
+    engine shape) as a versioned workload trace (``dsst-workload/1``,
+    ``out['workload']``): per-job arrival offset, board payload, mix
+    tier, measured route/verdict/wall — everything
+    ``benchmarks/replay.py`` needs to re-run the exact traffic as a
+    deterministic simnet capacity experiment.
     """
     from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
     from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
@@ -265,24 +308,62 @@ def compare_poisson(
     finally:
         static.stop(timeout=2)
 
+    resident_cfg = ResidentConfig(
+        job_slots=8,
+        gang_lanes=4,
+        queue_depth=max(16, n_jobs),
+        attach_batch=8,
+        chunk_steps=chunk_steps,
+    )
     resident = SolverEngine(
         config=cfg,
         max_batch=8,
         handicap_s=handicap_s,
         chunk_steps=chunk_steps,
-        resident=ResidentConfig(
-            job_slots=8,
-            gang_lanes=4,
-            queue_depth=max(16, n_jobs),
-            attach_batch=8,
-            chunk_steps=chunk_steps,
-        ),
+        resident=resident_cfg,
         frontdoor=_make_frontdoor(),
     ).start()
     try:
         _warm(resident)
         lats, jobs = poisson_load(resident, boards, mean_gap_s, seed)
         assert all(j.solved for j in jobs), "resident engine failed a job"
+        if record_workload:
+            # The workload trace (dsst-workload/1): the resident run's
+            # exact arrival schedule + boards + measured per-job
+            # route/verdict/wall.  `params` carries the SAME keys as the
+            # --out-json artifact params, so benchmarks/regress.py can
+            # prove a replay artifact and a live artifact measured the
+            # identical workload.
+            offsets = arrival_offsets(len(boards), mean_gap_s, seed)
+            out["workload"] = {
+                "schema": "dsst-workload/1",
+                "params": {
+                    "jobs": n_jobs,
+                    "mean_gap_ms": mean_gap_s * 1e3,
+                    "handicap_ms": handicap_s * 1e3,
+                    "chunk_steps": chunk_steps,
+                    "seed": seed,
+                    **({"mix": _mix_spec(mix)} if mix is not None else {}),
+                },
+                "engine": "resident",
+                "job_slots": resident_cfg.job_slots,
+                "queue_depth": resident_cfg.queue_depth,
+                "jobs_trace": [
+                    {
+                        "offset_ms": round(offsets[i] * 1e3, 3),
+                        "tier": tiers[i] if tiers is not None else "hard",
+                        "board": np.asarray(boards[i]).tolist(),
+                        "route": jobs[i].route or "direct",
+                        "wall_ms": (
+                            None if lats[i] == float("inf")
+                            else round(lats[i] * 1e3, 3)
+                        ),
+                        "solved": bool(jobs[i].solved),
+                        "unsat": bool(jobs[i].unsat),
+                    }
+                    for i in range(len(boards))
+                ],
+            }
         out["resident"] = _percentiles(lats)
         _route_tier_sections(out["resident"], lats, jobs)
         m_full = resident.metrics()
@@ -353,6 +434,14 @@ def main() -> None:
         "rpc_floor estimate, phase histograms) for "
         "benchmarks/regress.py — the bench-trajectory gate",
     )
+    ap.add_argument(
+        "--workload-out",
+        default=None,
+        help="record the resident run as a versioned workload trace "
+        "(dsst-workload/1: arrival offsets, board payloads, per-job "
+        "tier/route/verdict/wall) for benchmarks/replay.py — the "
+        "deterministic trace-replay capacity planner",
+    )
     args = ap.parse_args()
 
     rec = None
@@ -382,6 +471,7 @@ def main() -> None:
             seed=args.seed,
             chunk_steps=args.chunk_steps,
             mix=parse_mix(args.mix) if args.mix else None,
+            record_workload=bool(args.workload_out),
         )
     finally:
         compilewatch_mod.install(None)
@@ -424,6 +514,17 @@ def main() -> None:
             f"cold-cache run: {wm['compiles_total']} compile(s), "
             f"{out['compile']['wall_ms_total']:.0f} ms compile wall "
             "inside the measured window",
+            file=sys.stderr,
+        )
+    if args.workload_out:
+        workload = out.pop("workload")
+        tmp = args.workload_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(workload, f)
+        os.replace(tmp, args.workload_out)  # atomic like the artifact
+        print(
+            f"workload trace written: {args.workload_out} "
+            f"({len(workload['jobs_trace'])} jobs)",
             file=sys.stderr,
         )
     if args.out_json:

@@ -28,6 +28,20 @@ comparable to artifacts with the *identical* mix: the overall quantiles
 blend cache/native/device routes in mix-specific proportions, so a
 cross-mix compare is a different workload (**exit 2**), not a
 regression.
+
+**Replay-vs-live** (round 18): when one artifact is a ``dsst-replay/1``
+prediction (``benchmarks/replay.py``) and the other a live
+``dsst-bench-poisson/1`` run, the gate compares the replay's predicted
+per-tier p95 (overall resident p95 for all-hard traces) against the
+live resident numbers inside the same ``--tol`` band — **two-sided**,
+because a prediction can be wrong in either direction.  Comparability
+requires the replay's embedded workload params to match the live
+artifact's params exactly (same jobs/gaps/handicap/seed/mix — the same
+contract as the cross-mix rule: a different workload is **exit 2** with
+an explicit message, never a "regression").  Replay scaling knobs
+(``--nodes``/``--rate-x`` != the recorded shape) also make the
+prediction non-comparable to the recorded run: it predicts a different
+deployment on purpose.
 """
 
 from __future__ import annotations
@@ -38,8 +52,29 @@ import sys
 from typing import List, Optional, Union
 
 SCHEMA = "dsst-bench-poisson/1"
+REPLAY_SCHEMA = "dsst-replay/1"
 SIDES = ("static", "resident")
 QUANTS = ("p50_ms", "p95_ms")
+
+#: The workload-identity keys a replay prediction must share with a live
+#: artifact to be comparable (mix is normalized before comparing: the
+#: trace stores the canonical spelling, the live artifact the raw flag).
+WORKLOAD_KEYS = ("jobs", "mean_gap_ms", "handicap_ms", "chunk_steps", "seed")
+
+
+def _norm_mix(mix) -> Optional[str]:
+    """Canonicalize a --mix spelling ('hard:6,easy:20' == 'easy:20,hard:6'
+    == same workload); None/absent = the all-hard corpus."""
+    if not mix:
+        return None
+    counts = {"easy": 0, "hard": 0, "repeat": 0}
+    try:
+        for part in str(mix).split(","):
+            tier, n = part.split(":")
+            counts[tier.strip()] = int(n)
+    except (ValueError, KeyError):
+        return str(mix)  # unparseable: compare verbatim
+    return f"easy:{counts['easy']},hard:{counts['hard']},repeat:{counts['repeat']}"
 
 
 def _load(path: str):
@@ -153,6 +188,126 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
     }
 
 
+def compare_replay(replay: dict, live: dict, tol: float = 0.25) -> dict:
+    """Replay prediction vs live run: same report shape as :func:`compare`
+    (``regressions`` here means *mispredictions* — the replay's number
+    landed outside the two-sided tolerance band around the live one)."""
+    errors: List[str] = []
+    if not isinstance(replay, dict) or replay.get("schema") != REPLAY_SCHEMA:
+        errors.append(
+            f"replay artifact has schema "
+            f"{replay.get('schema') if isinstance(replay, dict) else replay!r}, "
+            f"expected {REPLAY_SCHEMA}"
+        )
+    if not isinstance(live, dict) or live.get("schema") != SCHEMA:
+        errors.append(
+            f"live artifact has schema "
+            f"{live.get('schema') if isinstance(live, dict) else live!r}, "
+            f"expected {SCHEMA}"
+        )
+    if not errors:
+        rp = replay.get("params", {}) or {}
+        wl = rp.get("workload", {}) or {}
+        lp = live.get("params", {}) or {}
+        for k in WORKLOAD_KEYS:
+            if wl.get(k) != lp.get(k):
+                errors.append(
+                    f"replay workload {k}={wl.get(k)!r} != live {k}="
+                    f"{lp.get(k)!r} — the replay predicts a DIFFERENT "
+                    "workload than the live run measured; re-record the "
+                    "trace from a run with identical flags"
+                )
+        if _norm_mix(wl.get("mix")) != _norm_mix(lp.get("mix")):
+            errors.append(
+                f"replay workload mix {wl.get('mix')!r} != live mix "
+                f"{lp.get('mix')!r} — a replay is only comparable to the "
+                "live run whose traffic it replays"
+            )
+        # Scaling knobs: a fleet-shape exploration predicts a different
+        # deployment on purpose — honest exit 2, never a "regression".
+        if rp.get("rate_x", 1.0) != 1.0:
+            errors.append(
+                f"replay ran at rate_x={rp.get('rate_x')} (scaled load): "
+                "comparable only at the recorded rate (rate_x=1)"
+            )
+        if rp.get("nodes", 1) != 1:
+            errors.append(
+                f"replay ran {rp.get('nodes')} virtual nodes: the recorded "
+                "run was one node — scale-out predictions are capacity "
+                "exploration, not a live comparison"
+            )
+        recorded = rp.get("recorded") or {}
+        for knob, rec_key in (("slots", "job_slots"),
+                              ("queue_depth", "queue_depth")):
+            rec_v = recorded.get(rec_key)
+            if rec_v is not None and rp.get(knob) != rec_v:
+                errors.append(
+                    f"replay ran {knob}={rp.get(knob)} but the trace "
+                    f"recorded {rec_key}={rec_v}: a reshaped node is "
+                    "capacity exploration, not a live comparison"
+                )
+    if errors:
+        return {
+            "comparable": False, "errors": errors, "regressions": [],
+            "improvements": [], "notes": [],
+        }
+    mispredictions: List[str] = []
+    notes: List[str] = []
+    live_res = live.get("resident", {}) or {}
+    live_tiers = live_res.get("tiers") or {}
+    pred_tiers = replay.get("tiers") or {}
+    pairs = []
+    if live_tiers:
+        for tier in sorted(live_tiers):
+            if tier in pred_tiers:
+                pairs.append(
+                    (f"tier {tier} p95", float(pred_tiers[tier]["p95_ms"]),
+                     float(live_tiers[tier]["p95_ms"]))
+                )
+            else:
+                notes.append(
+                    f"live tier {tier!r} absent from the replay prediction "
+                    "(all its jobs were shed?) — not compared"
+                )
+    elif replay.get("overall"):
+        pairs.append(
+            ("overall p95", float(replay["overall"]["p95_ms"]),
+             float(live_res.get("p95_ms", 0.0)))
+        )
+    shed_total = (replay.get("shed") or {}).get("total", 0)
+    if shed_total:
+        notes.append(
+            f"replay shed {shed_total} job(s): the recorded run shed none, "
+            "so predicted quantiles cover a smaller completed set"
+        )
+    if not pairs:
+        # A gate that compared NOTHING must not print OK: a replay that
+        # shed every job (overall=None) or a live artifact with no
+        # comparable quantiles is a failed comparison, not a pass.
+        return {
+            "comparable": False,
+            "errors": [
+                "no comparable quantiles between the replay prediction and "
+                "the live artifact (did the replay shed every job?)"
+            ],
+            "regressions": [], "improvements": [], "notes": notes,
+        }
+    for label, pred, actual in pairs:
+        if actual <= 0:
+            continue
+        lo, hi = actual * (1.0 - tol), actual * (1.0 + tol)
+        if not (lo <= pred <= hi):
+            mispredictions.append(
+                f"{label}: replay predicted {pred:.1f} ms vs live "
+                f"{actual:.1f} ms ({(pred / actual - 1) * 100:+.0f}%, "
+                f"tolerance ±{tol * 100:.0f}%)"
+            )
+    return {
+        "comparable": True, "errors": [], "regressions": mispredictions,
+        "improvements": [], "notes": notes,
+    }
+
+
 def main(argv: Union[List[str], None] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("old", help="baseline artifact (bench_poisson --out-json)")
@@ -171,7 +326,27 @@ def main(argv: Union[List[str], None] = None) -> int:
             print(f"regress: {err}", file=sys.stderr)
     if err_o or err_n:
         return 2
-    rep = compare(old, new, tol=args.tol)
+    schemas = tuple(
+        d.get("schema") if isinstance(d, dict) else None for d in (old, new)
+    )
+    replay_mode = REPLAY_SCHEMA in schemas
+    if replay_mode:
+        if schemas.count(REPLAY_SCHEMA) == 2:
+            print(
+                "regress: both artifacts are dsst-replay/1 predictions — "
+                "compare a prediction against a LIVE bench_poisson "
+                "--out-json artifact",
+                file=sys.stderr,
+            )
+            return 2
+        # Order-insensitive: whichever side is the replay is the
+        # prediction; the live run is the ground truth.
+        replay_doc, live_doc = (
+            (old, new) if schemas[0] == REPLAY_SCHEMA else (new, old)
+        )
+        rep = compare_replay(replay_doc, live_doc, tol=args.tol)
+    else:
+        rep = compare(old, new, tol=args.tol)
     if not rep["comparable"]:
         for e in rep["errors"]:
             print(f"regress: {e}", file=sys.stderr)
@@ -181,13 +356,20 @@ def main(argv: Union[List[str], None] = None) -> int:
     for line in rep["improvements"]:
         print(f"regress: improved: {line}")
     if rep["regressions"]:
+        tag = "MISPREDICTION" if replay_mode else "REGRESSION"
         for line in rep["regressions"]:
-            print(f"regress: REGRESSION: {line}", file=sys.stderr)
+            print(f"regress: {tag}: {line}", file=sys.stderr)
         return 1
-    print(
-        f"regress: OK — no regression beyond {args.tol * 100:.0f}% "
-        f"({', '.join(f'{s} {q}' for s in SIDES for q in QUANTS)})"
-    )
+    if replay_mode:
+        print(
+            f"regress: OK — replay prediction within ±{args.tol * 100:.0f}% "
+            "of the live run (per-tier p95)"
+        )
+    else:
+        print(
+            f"regress: OK — no regression beyond {args.tol * 100:.0f}% "
+            f"({', '.join(f'{s} {q}' for s in SIDES for q in QUANTS)})"
+        )
     return 0
 
 

@@ -1,0 +1,479 @@
+"""Deterministic trace-replay capacity planner: recorded traffic through
+simnet, with the brownout controller live.
+
+Capacity planning by guesswork ("surely 3 nodes survive Black Friday")
+is what ROADMAP #6 retires: ``bench_poisson --workload-out`` records real
+traffic as a versioned trace (``dsst-workload/1`` — arrival offsets,
+board payloads, per-job front-door tier/route/verdict/measured wall),
+and this harness replays it through ``cluster/simnet.py`` against N
+*virtual nodes* — queueing models of the serving node, each with its own
+live :class:`serving.brownout.BrownoutController` and
+:class:`obs.slo.SloMonitor` on the **virtual clock** — so "how many
+nodes before brownout engages?" is answered by a deterministic, sleep-
+free, socket-free experiment instead of an opinion.
+
+**The model.**  Each virtual node owns ``slots`` concurrent device
+servers (the resident flight's ``job_slots``) behind a bounded admission
+queue.  A replayed job's *service time* is its recorded end-to-end wall:
+under the recorded concurrency the replay therefore reproduces the live
+run (the regress.py acceptance — predicted per-tier p95 inside the noise
+band of the run that produced the trace), and under scaled load / fewer
+nodes the simulator's queueing adds honestly on top.  The caveat is
+stated out loud: recorded walls already include the *original* run's
+internal queueing, so scaled-up predictions are conservative (a real
+node would serve the uncontended tail slightly faster).  Front-door
+tiers cost what they cost in the trace: cache/propagation answers
+consume no slot (they are host-side microseconds), native-routed jobs
+run on the host pool, device/direct jobs contend for slots.
+
+**The control loop is live.**  Completions feed each node's SLO monitor
+(``solve`` stream) and queue depth feeds its pressure signals, so
+overload walks the node's brownout ladder exactly as in production:
+stage 1 is modelled as native-only admission, stage 2 sheds the easy
+tier (503), stage 3 sheds everything that would cost a dispatch (429) —
+shed responses are terminal, honest, and counted per tier/stage; cache
+and propagation jobs serve at every stage, and a full device queue
+answers the saturation 429 exactly like ``ResidentFlight`` (the bounded
+queue is real, not cosmetic).  The artifact
+(``dsst-replay/1``) reports predicted per-tier/per-route p50/p95, shed
+rates, stage residency, and transition counts.
+
+**Determinism.**  The driver is single-threaded and event-driven: it
+advances the virtual clock to each arrival, drains due completions in
+heap order, then routes the arrival through the simnet transport (one
+delivery thread runs the node handler while the driver blocks on the
+reply) — there is never more than one handler in flight, virtual
+timestamps are exact, and two seeded runs produce byte-identical
+artifacts (pinned in tests/test_replay.py).  ``--speed N`` optionally
+paces the replay at N x recorded time for live observation; the default
+(0) runs flat out — virtual time is free.
+
+Run::
+
+    python benchmarks/bench_poisson.py --mix easy:20,hard:6,repeat:22 \
+        --workload-out trace.json --out-json live.json
+    python benchmarks/replay.py trace.json --nodes 1 --out-json replay.json
+    python benchmarks/regress.py replay.json live.json   # predicted vs live
+    python benchmarks/replay.py trace.json --nodes 3 --rate-x 10  # capacity
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+import sys
+import threading
+from typing import Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from any cwd without installing
+    sys.path.insert(0, REPO)
+
+from distributed_sudoku_solver_tpu.cluster.simnet import SimNet
+from distributed_sudoku_solver_tpu.obs import slo as slo_mod
+from distributed_sudoku_solver_tpu.serving import brownout
+
+SCHEMA = "dsst-replay/1"
+WORKLOAD_SCHEMA = "dsst-workload/1"
+
+#: Routes that consume a device slot in the model (everything the live
+#: system pays a dispatch for; ``direct`` is the no-frontdoor spelling).
+DEVICE_ROUTES = ("device", "direct")
+#: Routes answered host-side with no slot and no gate (microseconds in
+#: the live system; they serve at every brownout stage).
+FREE_ROUTES = ("cache", "propagation")
+
+
+def _percentiles(lats_ms: list) -> dict:
+    arr = np.asarray(sorted(lats_ms), float)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 1),
+        "p95_ms": round(float(np.percentile(arr, 95)), 1),
+        "p99_ms": round(float(np.percentile(arr, 99)), 1),
+        "mean_ms": round(float(arr.mean()), 1),
+        "jobs": len(lats_ms),
+    }
+
+
+class VirtualNode:
+    """One serving node as a queueing model with a live control plane.
+
+    All state mutates either on the driver thread (:meth:`drain_until`)
+    or on the single in-flight simnet delivery thread (:meth:`_handle`)
+    — never both at once, because the driver blocks on every request's
+    reply before advancing (module docstring), so the model needs no
+    locking and stays deterministic.
+    """
+
+    def __init__(
+        self,
+        net: SimNet,
+        index: int,
+        slots: int,
+        queue_depth: int,
+        bo_config: brownout.BrownoutConfig,
+        slo_spec: str,
+        slo_window_s: float,
+    ):
+        self.net = net
+        self.transport = net.transport()
+        self.addr = self.transport.bind("replay", 7100 + index)
+        self.addr_s = f"replay:{7100 + index}"
+        self.transport.serve(self._handle)
+        self.slots = slots
+        self.queue_depth = queue_depth
+        self.mon = slo_mod.SloMonitor(
+            slo_mod.parse_slo(slo_spec),
+            window_s=slo_window_s,
+            clock=net.now,
+        )
+        self.ctrl = brownout.BrownoutController(
+            bo_config,
+            clock=net.now,
+            signals={
+                "burn": self._burn_signal,
+                "queue": lambda: len(self._wait_q) / float(self.queue_depth),
+            },
+        )
+        self._busy = 0  # device slots in service
+        self._wait_q: list = []  # FIFO of (arrival_t, job) awaiting a slot
+        self._running: list = []  # heap of (finish_t, seq, arrival_t, job)
+        self._seq = 0
+        self.completed: list = []  # (job, arrival_t, wall_s)
+        self.shed: list = []  # (job, stage, status, tier)
+
+    def _burn_signal(self) -> Optional[float]:
+        # The production formula, shared (serving/brownout.max_burn): the
+        # replayed ladder must never drift onto a different burn signal
+        # than the one the live controller acts on.
+        return brownout.max_burn(self.mon)
+
+    # -- simnet handler (the arrival path) -----------------------------------
+    def _handle(self, msg: dict) -> dict:
+        if msg.get("method") != "SOLVE":
+            return {"error": "unknown method"}
+        job = msg["job"]
+        now = self.net.now()
+        route = job.get("route", "direct")
+        if route in FREE_ROUTES:
+            # Cache hits / propagation verdicts: host-side microseconds,
+            # no slot, admitted at every brownout stage.
+            self._start(job, now)
+            return {"accepted": True}
+        # Gate tier = the probe's classification, reconstructed from the
+        # trace: a generated-easy board whose device shadow won the
+        # recorded race (tier='easy', route='device') is still probe-easy
+        # — production sheds it at stage 2 BEFORE any racing happens.
+        tier = (
+            "easy" if job.get("tier") == "easy" or route == "native"
+            else "hard"
+        )
+        action, stage = self.ctrl.gate(tier)
+        if action == brownout.SHED:
+            status = 503 if stage == 2 else 429
+            self.ctrl.record_shed(tier, stage)
+            self.shed.append((job, stage, status, tier))
+            # Shed responses are observed as NON-errors and excluded from
+            # latency objectives — the production contract
+            # (serving/http.py _record_solve shed=True).
+            self.mon.observe(0.0, error=False, stream="solve", shed=True)
+            return {
+                "shed": True, "status": status, "stage": stage,
+                "shed_tier": tier,
+            }
+        # NATIVE_ONLY needs no modelling beyond admission: the recorded
+        # wall of a native-routed job IS its native service time (the
+        # suppressed device shadow never won in the recorded run either,
+        # or the route would say 'device').
+        if not self._start(job, now):
+            # Bounded admission queue, exactly like ResidentFlight: a
+            # full queue answers the saturation 429 instead of queueing
+            # unboundedly — without this the replay "completes" jobs
+            # real clients would have been refused, and overload
+            # predictions diverge exactly where they matter.
+            self.shed.append((job, stage, 429, "saturated"))
+            self.mon.observe(0.0, error=False, stream="solve", shed=True)
+            return {"shed": True, "status": 429, "shed_tier": "saturated"}
+        return {"accepted": True}
+
+    def _start(self, job: dict, now: float) -> bool:
+        """Begin (or queue) service; False = the bounded device queue is
+        full (the caller answers the saturation 429)."""
+        service_s = (job.get("wall_ms") or 0.0) / 1e3
+        if job.get("route", "direct") in DEVICE_ROUTES:
+            if self._busy >= self.slots:
+                if len(self._wait_q) >= self.queue_depth:
+                    return False
+                self._wait_q.append((now, job))
+                return True
+            self._busy += 1
+        self._seq += 1
+        heapq.heappush(self._running, (now + service_s, self._seq, now, job))
+        return True
+
+    # -- driver surface ------------------------------------------------------
+    def drain_until(self, t: float) -> None:
+        """Complete every job whose finish time has passed (heap order =
+        deterministic), recycle freed slots into the wait queue, feed the
+        SLO monitor, and let the brownout ladder re-evaluate."""
+        while self._running and self._running[0][0] <= t:
+            finish_t, _seq, arrival_t, job = heapq.heappop(self._running)
+            wall_s = finish_t - arrival_t
+            self.completed.append((job, arrival_t, wall_s))
+            self.mon.observe(wall_s, error=False, stream="solve")
+            if job.get("route", "direct") in DEVICE_ROUTES:
+                self._busy -= 1
+                if self._wait_q:
+                    q_arrival, queued = self._wait_q.pop(0)
+                    self._busy += 1
+                    self._seq += 1
+                    service_s = (queued.get("wall_ms") or 0.0) / 1e3
+                    heapq.heappush(
+                        self._running,
+                        (finish_t + service_s, self._seq, q_arrival, queued),
+                    )
+        # The control loop ticks on the virtual clock (rate-limited by
+        # eval_interval_s) so stages climb under backlog and walk back
+        # down through the trailing quiet window.
+        self.ctrl.stage()
+
+    def busy(self) -> bool:
+        return bool(self._running or self._wait_q)
+
+    def outstanding(self) -> int:
+        """In-service + queued jobs (the routing load signal)."""
+        return len(self._running) + len(self._wait_q)
+
+
+def load_workload(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("schema") != WORKLOAD_SCHEMA:
+        raise SystemExit(
+            f"replay: {path} is not a {WORKLOAD_SCHEMA} workload trace "
+            f"(schema={doc.get('schema') if isinstance(doc, dict) else '?'})"
+        )
+    return doc
+
+
+def replay(
+    workload: dict,
+    nodes: int = 1,
+    slots: Optional[int] = None,
+    queue_depth: Optional[int] = None,
+    rate_x: float = 1.0,
+    speed: float = 0.0,
+    seed: int = 0,
+    bo_config: Optional[brownout.BrownoutConfig] = None,
+    slo_spec: str = "solve_p95_ms<=2000,error_rate<=0.01",
+    slo_window_s: float = 30.0,
+    cooldown_s: Optional[float] = None,
+) -> dict:
+    """Run one replay experiment; returns the ``dsst-replay/1`` artifact.
+
+    ``rate_x`` compresses the arrival schedule (2.0 = double the load —
+    the capacity-exploration knob); ``nodes``/``slots`` scale the fleet;
+    ``speed`` paces wall-clock playback (0 = flat out, virtual time is
+    free).  The trailing ``cooldown_s`` of virtual quiet (default: enough
+    for a full ladder walk-down) lets the brownout controllers recover so
+    the artifact's final stage is the steady state, not the last burst.
+    """
+    slots = slots if slots is not None else int(workload.get("job_slots", 8))
+    queue_depth = (
+        queue_depth if queue_depth is not None
+        else int(workload.get("queue_depth", 64))
+    )
+    bo_config = bo_config or brownout.BrownoutConfig(quiet_s=5.0, hold_s=0.5)
+    if cooldown_s is None:
+        # Enough quiet for the whole ladder to walk down: the SLO window
+        # must age out the overload observations FIRST (burn only decays
+        # once they leave the window), then one full quiet window per
+        # stage.
+        cooldown_s = (
+            slo_window_s + bo_config.quiet_s * (brownout.MAX_STAGE + 1) + 5.0
+        )
+    net = SimNet(seed=seed)
+    vnodes = [
+        VirtualNode(
+            net, i, slots, queue_depth, bo_config, slo_spec, slo_window_s
+        )
+        for i in range(max(1, int(nodes)))
+    ]
+    client = net.transport()
+    trace_jobs = sorted(
+        workload["jobs_trace"], key=lambda j: (j["offset_ms"], j.get("tier", ""))
+    )
+    pacer = threading.Event()  # never set: wait() is a bounded real yield
+    replies = []
+    max_stage = 0
+    for i, job in enumerate(trace_jobs):
+        t = (job["offset_ms"] / 1e3) / max(rate_x, 1e-9)
+        dt = t - net.now()
+        if dt > 0:
+            if speed > 0:
+                pacer.wait(dt / speed)
+            net.advance(dt, settle=False)
+        for vn in vnodes:
+            vn.drain_until(net.now())
+            max_stage = max(max_stage, vn.ctrl.stage())
+        # Least-outstanding routing (ClusterNode._pick_member's policy),
+        # ties to the lowest index — deterministic, and immune to the
+        # round-robin/tier-pattern aliasing that parks every device job
+        # on one member of a small fleet.
+        target = min(vnodes, key=lambda vn: (vn.outstanding(), vn.addr_s))
+        replies.append(
+            client.request(target.addr_s, {"method": "SOLVE", "job": job}, 60.0)
+        )
+    # Drain: advance until every node is idle, then the cooldown window so
+    # the ladders walk back down (the acceptance soak pins ...->0).
+    while any(vn.busy() for vn in vnodes):
+        net.advance(0.25, settle=False)
+        for vn in vnodes:
+            vn.drain_until(net.now())
+            max_stage = max(max_stage, vn.ctrl.stage())
+    end_of_traffic = net.now()
+    while net.now() < end_of_traffic + cooldown_s:
+        net.advance(1.0, settle=False)
+        for vn in vnodes:
+            vn.drain_until(net.now())
+    net.close()
+
+    completed = [c for vn in vnodes for c in vn.completed]
+    shed = [s for vn in vnodes for s in vn.shed]
+    by_tier: dict = {}
+    by_route: dict = {}
+    for job, _arrival, wall_s in completed:
+        by_tier.setdefault(job.get("tier", "hard"), []).append(wall_s * 1e3)
+        by_route.setdefault(job.get("route", "direct"), []).append(wall_s * 1e3)
+    shed_by_tier: dict = {}
+    shed_by_status: dict = {}
+    for _job, _stage, status, tier in shed:
+        shed_by_tier[tier] = shed_by_tier.get(tier, 0) + 1
+        shed_by_status[str(status)] = shed_by_status.get(str(status), 0) + 1
+    residency = [0.0] * (brownout.MAX_STAGE + 1)
+    transitions = 0
+    final_stages = []
+    for vn in vnodes:
+        m = vn.ctrl.metrics()
+        transitions += m["transitions"]
+        final_stages.append(m["stage"])
+        for k, r in enumerate(m["stage_residency_s"]):
+            residency[k] = round(residency[k] + r, 3)
+    all_walls = [wall_s * 1e3 for _j, _a, wall_s in completed]
+    artifact = {
+        "schema": SCHEMA,
+        "params": {
+            "workload": workload.get("params", {}),
+            "nodes": len(vnodes),
+            "slots": slots,
+            "queue_depth": queue_depth,
+            # The trace's recorded shape, echoed so regress.py can tell a
+            # same-shape prediction (comparable to the live run) from a
+            # capacity exploration (--slots/--queue-depth overridden).
+            "recorded": {
+                "job_slots": workload.get("job_slots"),
+                "queue_depth": workload.get("queue_depth"),
+            },
+            "rate_x": rate_x,
+            "seed": seed,
+            "slo": slo_spec,
+            "brownout": {
+                "enter": bo_config.enter,
+                "exit": bo_config.exit,
+                "quiet_s": bo_config.quiet_s,
+            },
+        },
+        "jobs": len(trace_jobs),
+        "completed": len(completed),
+        "shed": {
+            "total": len(shed),
+            "by_tier": shed_by_tier,
+            "by_status": shed_by_status,
+        },
+        "overall": _percentiles(all_walls) if all_walls else None,
+        "tiers": {t: _percentiles(v) for t, v in sorted(by_tier.items())},
+        "routes": {r: _percentiles(v) for r, v in sorted(by_route.items())},
+        "stage_residency_s": residency,
+        "transitions": transitions,
+        "max_stage": max_stage,
+        "final_stages": final_stages,
+        "brownout_engaged": max_stage > 0,
+    }
+    # Every replayed request is accounted: completed + shed == offered,
+    # and the shed REPLIES the client saw agree with the nodes' internal
+    # accounting (honest 429/503s, never silent drops).
+    assert len(completed) + len(shed) == len(trace_jobs), (
+        len(completed), len(shed), len(trace_jobs),
+    )
+    assert sum(1 for r in replies if r.get("shed")) == len(shed)
+    return artifact
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("workload", help="dsst-workload/1 trace "
+                    "(bench_poisson --workload-out)")
+    ap.add_argument("--nodes", type=int, default=1,
+                    help="virtual serving nodes (least-outstanding "
+                    "routing, ClusterNode._pick_member's policy)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="device slots per node (default: the trace's "
+                    "recorded resident job_slots)")
+    ap.add_argument("--queue-depth", type=int, default=None,
+                    help="admission queue bound per node (default: the "
+                    "trace's recorded queue depth)")
+    ap.add_argument("--rate-x", type=float, default=1.0,
+                    help="compress the arrival schedule by this factor "
+                    "(2.0 = double the offered load — the capacity knob)")
+    ap.add_argument("--speed", type=float, default=0.0,
+                    help="pace playback at N x recorded time for live "
+                    "observation (10/100); 0 = flat out (virtual time is "
+                    "free, the default)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slo", default="solve_p95_ms<=2000,error_rate<=0.01",
+                    help="the virtual nodes' SLO spec (obs/slo.py grammar) "
+                    "— its burn drives the replayed brownout ladder")
+    ap.add_argument("--brownout-enter", type=float, default=1.0)
+    ap.add_argument("--brownout-exit", type=float, default=0.5)
+    ap.add_argument("--brownout-quiet", type=float, default=5.0)
+    ap.add_argument("--out-json", default=None,
+                    help="write the dsst-replay/1 artifact (regress.py "
+                    "compares it against a live bench_poisson --out-json "
+                    "artifact of the same workload)")
+    args = ap.parse_args(argv)
+
+    workload = load_workload(args.workload)
+    artifact = replay(
+        workload,
+        nodes=args.nodes,
+        slots=args.slots,
+        queue_depth=args.queue_depth,
+        rate_x=args.rate_x,
+        speed=args.speed,
+        seed=args.seed,
+        bo_config=brownout.BrownoutConfig(
+            enter=args.brownout_enter,
+            exit=args.brownout_exit,
+            quiet_s=args.brownout_quiet,
+            hold_s=0.5,
+        ),
+        slo_spec=args.slo,
+    )
+    if args.out_json:
+        tmp = args.out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f)
+        os.replace(tmp, args.out_json)
+        print(f"artifact written: {args.out_json}", file=sys.stderr)
+    print(json.dumps({k: v for k, v in artifact.items() if k != "params"},
+                     indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -118,6 +118,12 @@ LAYERS = {
         "allow": ("serving", "obs", "models.geometry", "native"),
         "third_party": ("numpy",),
     },
+    # The brownout controller (serving/brownout.py, ISSUE 15) is a closed
+    # stdlib+obs layer: it reads the SLO plane and writes trace events,
+    # but every serving-side signal reaches it as an injected callable
+    # (engine_signals' duck-typed closures) — importing the engine back
+    # would cycle through engine.metrics' brownout section.
+    "serving.brownout": {"closed": True, "allow": ("obs",), "third_party": ()},
     # serving sits BELOW cluster (cluster/node.py imports serving.engine):
     # a serving -> cluster import would be a cycle by construction.
     "serving": {"closed": False, "forbid": ("cluster",)},
@@ -533,6 +539,14 @@ LOCK_RANKS = {
     "cluster.node": 10,       # cluster/node.py ClusterNode._lock (RLock)
     "cluster.exec": 16,       # cluster/node.py _Exec.lock
     "obs.slo": 24,            # obs/slo.py SloMonitor._lock (RLock)
+    # Between obs.slo and the serving coordination locks: the slo
+    # burn-dump's metrics_fn closure reaches engine.metrics -> the
+    # brownout section while HOLDING the slo RLock (so brownout must
+    # rank above 24), and the controller's own lock is a LEAF by
+    # construction — signal callables are read and transition side
+    # effects fired with it released (serving/brownout.py evaluate), so
+    # nothing is ever acquired under it.
+    "serving.brownout": 28,   # serving/brownout.py BrownoutController._lock
     "serving.engine": 30,     # serving/engine.py SolverEngine._lock
     "serving.scheduler": 34,  # serving/scheduler.py ResidentFlight._lock
     "serving.breaker": 38,    # serving/faults.py CircuitBreaker._lock
@@ -585,8 +599,24 @@ _SLO_DUMP_REASON = (
 )
 
 LOCK_EDGE_DECLARED = {
+    # Virtual-clock injection: a simnet-clocked SloMonitor (the replay
+    # harness's virtual nodes, benchmarks/replay.py; any simnet-lane
+    # monitor) reads clock=net.now — the SimNet condition — under its
+    # own RLock.  Rank-legal by construction (obs.slo 24 < cluster.simnet
+    # 72, the terminal leaf every injected SimClock read lands on), and
+    # invisible to statics for the same injected-callable reason as the
+    # burn-dump closure below.
+    ("obs.slo", "cluster.simnet"): (
+        "injected virtual clock: SloMonitor(clock=net.now) reads the "
+        "SimNet condition inside its locked prune/observe paths"
+    ),
+}
+LOCK_EDGE_DECLARED.update({
     ("obs.slo", target): _SLO_DUMP_REASON
     for target in (
+        # engine.metrics reads the brownout controller's counters when
+        # one is installed (round 18) — same injected-callable closure.
+        "serving.brownout",
         "serving.engine",
         "serving.scheduler",
         "serving.breaker",
@@ -603,7 +633,7 @@ LOCK_EDGE_DECLARED = {
         "obs.minest",
         "utils.statwindow",
     )
-}
+})
 
 # Cross-module receiver hints for deadck's call/lock resolution: the
 # static half cannot type expressions, so the handful of conventional
@@ -631,6 +661,9 @@ DEADCK_BASE_CLASSES = {
     "self.frontdoor": ("serving/frontdoor/router.py", "FrontDoor"),
     "self.cache": ("serving/frontdoor/cache.py", "ResultCache"),
     "fd": ("serving/frontdoor/router.py", "FrontDoor"),
+    "ctrl": ("serving/brownout.py", "BrownoutController"),
+    "self.ctrl": ("serving/brownout.py", "BrownoutController"),
+    "bo": ("serving/brownout.py", "BrownoutController"),
 }
 
 # The repo's thread roots: qualname prefixes (per file) whose bodies run
@@ -668,6 +701,14 @@ DEADCK_THREAD_ROOTS = {
         "race",                   # racer entrant threads (device/native)
         "race_cover",
         "race_jobs",
+    ),
+    "serving/brownout.py": (
+        # The controller is reached from HTTP handler threads (the front
+        # door's gate), the device loop (engine.metrics), and any
+        # metrics scraper — declared as its own root family so deadck's
+        # guard inference PROVES every counter write is lock-guarded
+        # rather than trusting the annotations.
+        "BrownoutController.evaluate",
     ),
     "utils/profiling.py": (
         "_close_profile_window",  # the profile-window daemon timer

@@ -241,6 +241,43 @@ def build_parser() -> argparse.ArgumentParser:
         "allowable rate)",
     )
     ap.add_argument(
+        "--brownout",
+        action="store_true",
+        help="force the brownout controller on even without --slo "
+        "(serving/brownout.py; queue/wait/floor signals still drive the "
+        "ladder — there is just no burn signal).  With --slo the "
+        "controller is on by default",
+    )
+    ap.add_argument(
+        "--no-brownout",
+        action="store_true",
+        help="disable SLO-burn-driven load shedding: a burn crossing "
+        "then only dumps the flight recorder, as before round 18",
+    )
+    ap.add_argument(
+        "--brownout-enter",
+        type=float,
+        default=1.0,
+        help="pressure (normalized: 1.0 = at the configured limit — max "
+        "over SLO burn, resident queue fill, admission-wait p95, "
+        "rpc-floor drift) at which the brownout ladder climbs one stage",
+    )
+    ap.add_argument(
+        "--brownout-exit",
+        type=float,
+        default=0.5,
+        help="pressure at or below which calm accrues; after "
+        "--brownout-quiet continuous seconds of calm the ladder steps "
+        "down one stage (must be < --brownout-enter: the hysteresis band)",
+    )
+    ap.add_argument(
+        "--brownout-quiet",
+        type=float,
+        default=15.0,
+        help="continuous calm (pressure <= --brownout-exit) before the "
+        "brownout ladder de-escalates one stage",
+    )
+    ap.add_argument(
         "--access-log",
         action="store_true",
         help="log one INFO record per HTTP request (logger "
@@ -493,6 +530,25 @@ def main(argv=None) -> None:
             # Burn dumps embed a metrics snapshot; injected here because
             # obs/slo.py never imports the serving layer back.
             slo_monitor.metrics_fn = engine.metrics
+        if not args.no_brownout and (args.brownout or args.slo):
+            # Close the observability->control loop (serving/brownout.py):
+            # on by default whenever --slo is set — a node that measures
+            # its burn should act on it.  Bound post-boot because the
+            # signal closures read the live engine (the slo metrics_fn
+            # pattern above).
+            from distributed_sudoku_solver_tpu.serving import (
+                brownout as brownout_mod,
+            )
+
+            ctrl = brownout_mod.BrownoutController(
+                brownout_mod.BrownoutConfig(
+                    enter=args.brownout_enter,
+                    exit=args.brownout_exit,
+                    quiet_s=args.brownout_quiet,
+                )
+            )
+            brownout_mod.bind_engine(ctrl, engine)
+            brownout_mod.install(ctrl)
         node = ClusterNode(
             engine,
             host=args.host,

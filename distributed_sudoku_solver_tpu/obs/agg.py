@@ -21,6 +21,10 @@ that merge *soundly*:
   and per-phase attribution totals; cluster shares are re-derived from
   the merged totals (the per-phase ``critpath_*_ms`` histograms already
   merge through the ``hist`` rule above).
+* **Brownout sections** (``brownout``, serving/brownout.py) sum
+  transition/shed counters and residency vectors; the per-node stage
+  max-merges (``stage_max``) with a browning-member count, and
+  :func:`status_from` turns browning members AMBER.
 
 Everything else — percentile snapshots, per-geometry breakdowns, string
 state — is deliberately NOT rolled up: those live in the per-node
@@ -79,6 +83,38 @@ def _merge_compile(acc: dict, sec: dict) -> None:
             acc[f] = acc.get(f, 0) + v
 
 
+def _merge_brownout(acc: dict, sec: dict) -> None:
+    """Sum one member's ``brownout`` section (serving/brownout.py):
+    transition/shed counters and residency vectors sum soundly; the
+    stage itself is per-node state, so the rollup carries the MAX stage
+    across members plus a browning-member count — "is anyone shedding,
+    and how hard" has one cluster answer while each node's own stage
+    stays in the per-node breakdown."""
+    for f in ("transitions", "escalations", "deescalations", "shed_total"):
+        v = sec.get(f)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            acc[f] = acc.get(f, 0) + v
+    stage = sec.get("stage")
+    if isinstance(stage, int) and not isinstance(stage, bool):
+        acc["stage_max"] = max(acc.get("stage_max", 0), stage)
+        if stage > 0:
+            acc["browning_members"] = acc.get("browning_members", 0) + 1
+    shed = sec.get("shed")
+    if isinstance(shed, dict):
+        slot = acc.setdefault("shed", {})
+        for t in sorted(shed, key=str):
+            v = shed[t]
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                slot[str(t)] = slot.get(str(t), 0) + v
+    res = sec.get("stage_residency_s")
+    if isinstance(res, list) and all(
+        isinstance(v, (int, float)) and not isinstance(v, bool) for v in res
+    ):
+        cur = acc.setdefault("stage_residency_s", [0.0] * len(res))
+        for i, v in enumerate(res[: len(cur)]):
+            cur[i] = round(cur[i] + v, 3)
+
+
 def _merge_critpath(acc: dict, sec: dict) -> None:
     """Sum one member's ``critpath`` section: jobs + per-phase
     attribution totals (ms sums merge soundly; shares are re-derived
@@ -104,6 +140,7 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
     floor: Optional[dict] = None
     compile_acc: dict = {}
     critpath_acc: dict = {}
+    brownout_acc: dict = {}
     for body in bodies:
         if not isinstance(body, dict):
             continue
@@ -123,6 +160,8 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
             _merge_compile(compile_acc, body["compile"])
         if isinstance(body.get("critpath"), dict):
             _merge_critpath(critpath_acc, body["critpath"])
+        if isinstance(body.get("brownout"), dict):
+            _merge_brownout(brownout_acc, body["brownout"])
     quantiles = {}
     for k, h in hists.items():
         n = hist_mod.hist_count(h)
@@ -140,6 +179,8 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
         out["rpc_floor_ms"] = floor
     if compile_acc:
         out["compile"] = compile_acc
+    if brownout_acc:
+        out["brownout"] = brownout_acc
     if critpath_acc:
         total = sum(
             v for v in critpath_acc.get("attribution_ms", {}).values()
@@ -182,6 +223,16 @@ def status_from(cluster_view: dict) -> dict:
     burning = bool(slo_state and slo_state.get("burning")) or bool(
         burning_members
     )
+    # A browning-out member turns the ring AMBER the way a burning one
+    # turns it red: the member is still serving (cache/hard-tail answers
+    # at stage <= 2), but it is refusing part of its traffic on purpose —
+    # capacity planning should hear that before the budget burns.
+    brownout_members = sorted(
+        addr
+        for addr, n in nodes.items()
+        if isinstance(n.get("metrics"), dict)
+        and int((n["metrics"].get("brownout") or {}).get("stage") or 0) > 0
+    )
     return {
         "address": cluster_view.get("address"),
         "coordinator": cluster_view.get("coordinator"),
@@ -193,6 +244,16 @@ def status_from(cluster_view: dict) -> dict:
         "counters": ru.get("counters", {}),
         "slo": slo_state,
         "slo_burning_members": burning_members,
+        "brownout_members": brownout_members,
+        # The compact traffic light: red = an objective is burning
+        # somewhere, amber = someone is shedding (or the rollup is
+        # partial), green = all clear.  `healthy`/`degraded` keep their
+        # pre-round-18 meanings for existing consumers.
+        "state": (
+            "red" if burning
+            else "amber" if (brownout_members or unreachable > 0)
+            else "green"
+        ),
         # Degraded = the aggregation itself is partial (a member did not
         # answer); healthy additionally requires no objective burning
         # anywhere in the ring.

@@ -55,6 +55,9 @@ _LABEL_DICTS = {
     # routing tier (cache/propagation/native/device) under a `route`
     # label, mirroring the frontdoor_<route>_ms histograms in `hist`.
     "routes": "route",
+    # Brownout per-tier shed counters (serving/brownout.py): one series
+    # per shed tier (easy/hard) under a `tier` label.
+    "shed": "tier",
 }
 
 

@@ -181,13 +181,23 @@ class SloMonitor:
 
     # -- the observation feed ------------------------------------------------
     def observe(
-        self, latency_s: float, error: bool = False, stream: str = "solve"
+        self, latency_s: float, error: bool = False, stream: str = "solve",
+        shed: bool = False,
     ) -> None:
         """One observation on ``stream``: the HTTP layer feeds ``solve``
         (wall + status>=500 as error), the engine feeds ``job`` (wall +
         job failure).  Objectives only see their own stream's totals, so
         a 504 storm burns the ``solve`` objectives even though the
-        underlying jobs merely got cancelled."""
+        underlying jobs merely got cancelled.
+
+        ``shed=True`` marks a deliberate load-shedding response (a
+        brownout 503/429, a saturation 429 — serving/brownout.py): it
+        counts toward ``error_rate`` totals as a NON-error (an honest
+        refusal must not burn the budget it protects) but is EXCLUDED
+        from latency objectives entirely — a storm of ~1 ms refusals
+        would otherwise dilute the latency window, collapse the burn
+        signal, and flap the brownout ladder that produced them (the
+        served requests' latency is the thing the objective watches)."""
         with self._lock:
             now = self._clock()
             bid = int(now // self._sub_s)
@@ -200,8 +210,13 @@ class SloMonitor:
             for i, o in enumerate(self.objectives):
                 if o.stream != stream:
                     continue
+                if o.kind == "error_rate":
+                    bad = error and not shed
+                elif shed:
+                    continue  # refusals carry no service latency
+                else:
+                    bad = lat_ms > o.threshold
                 b[1][i] += 1
-                bad = error if o.kind == "error_rate" else lat_ms > o.threshold
                 if bad:
                     b[2][i] += 1
             self.observed += 1
@@ -285,6 +300,32 @@ class SloMonitor:
             self.dumps += 1
 
     # -- read surface --------------------------------------------------------
+    def burn_snapshot(self) -> dict:
+        """Per-objective current burn as a PUBLIC read API (ISSUE 15):
+        before this, burn was only observable at crossing edges (the
+        dump), which also made anything that wants to *act* on burn — the
+        brownout controller (``serving/brownout.py``) — untestable without
+        a traffic burst.  Each entry: current ``burn_rate``, ``headroom``
+        (distance below the crossing threshold; negative = burning),
+        ``burning``, and the windowed totals the rate was computed from.
+        Prunes + quiet-evaluates like every read, so the snapshot decays
+        when traffic stops.  Surfaced under ``GET /slo`` as ``burn``."""
+        with self._lock:
+            self._prune_locked(int(self._clock() // self._sub_s))
+            self._evaluate_quiet_locked()
+            total, bad, rates = self._burn_rates_locked()
+            return {
+                o.name: {
+                    "stream": o.stream,
+                    "burn_rate": round(rates[i], 4),
+                    "headroom": round(self.burn_threshold - rates[i], 4),
+                    "burning": self._burning[i],
+                    "window_total": int(total[i]),
+                    "window_bad": int(bad[i]),
+                }
+                for i, o in enumerate(self.objectives)
+            }
+
     def burning(self) -> bool:
         with self._lock:
             self._prune_locked(int(self._clock() // self._sub_s))

@@ -65,7 +65,7 @@ from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram, MinEstimato
 from distributed_sudoku_solver_tpu.obs.logctx import job_log, uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
 from distributed_sudoku_solver_tpu.ops.solve import solve_batch
-from distributed_sudoku_solver_tpu.serving import faults
+from distributed_sudoku_solver_tpu.serving import brownout, faults
 
 # Diagnostics go through logging (stderr via the root handler / logging's
 # lastResort), not print(): failure paths log at ERROR with the fault
@@ -485,8 +485,11 @@ class SolverEngine:
             # paths below, then COMMIT the routing decision (counters,
             # cache-fill registration) only once placement succeeded, so
             # an EngineSaturated 429 never inflates the device-route
-            # counters or parks a dead cache-fill entry.
-            owned, fd_token = self.frontdoor.route(job)
+            # counters or parks a dead cache-fill entry.  ``saturation``
+            # rides along for the brownout gate (serving/brownout.py):
+            # only reject-mode submits — the serving boundary — may be
+            # shed with a BrownoutShed raise; quiet callers degrade.
+            owned, fd_token = self.frontdoor.route(job, saturation=saturation)
             if owned:
                 return job
             fd_routed = True
@@ -840,6 +843,14 @@ class SolverEngine:
         if mon is not None:
             # SLO plane health (obs/slo.py): burn rates, breaches, dumps.
             out["slo"] = mon.metrics()
+        bo = brownout.active()
+        if bo is not None:
+            # The brownout controller (serving/brownout.py): current
+            # stage, transition counters, per-tier shed counts, stage
+            # residency, and the last evaluated pressure readings — the
+            # section obs/agg.py rolls up cluster-wide and /status scans
+            # for browning-out members.
+            out["brownout"] = bo.metrics()
         if self._occ_chunks > 0:
             # Lane-occupancy inside fused dispatches: counts[k] = lanes
             # observed live for [10k, 10(k+1))% of the rounds their chunk

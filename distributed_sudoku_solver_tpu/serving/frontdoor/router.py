@@ -31,6 +31,7 @@ import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
 from distributed_sudoku_solver_tpu.obs import lockdep, slo, trace
+from distributed_sudoku_solver_tpu.serving import brownout
 from distributed_sudoku_solver_tpu.serving.frontdoor import cache as cache_mod
 from distributed_sudoku_solver_tpu.serving.frontdoor import canonical as canon_mod
 
@@ -179,7 +180,7 @@ class FrontDoor:
             self.native_available = False
 
     # -- the submit seam -----------------------------------------------------
-    def route(self, job):
+    def route(self, job, saturation: str = "fallback"):
         """Route one eligible job.  Returns ``(owned, token)``:
         ``owned=True`` means the front door resolved it (cache /
         propagation) or the native race will; ``owned=False`` means hard
@@ -187,7 +188,18 @@ class FrontDoor:
         placement SUCCEEDED, hands ``token`` to :meth:`commit_device`
         (which does the device-route bookkeeping; deferring it keeps a
         saturation 429 from inflating counters or parking a dead
-        cache-fill entry)."""
+        cache-fill entry).
+
+        With a brownout controller installed (``serving/brownout.py``)
+        the routing decision is also the SHEDDING point — the one place
+        in the system where a request's cost tier is known before any of
+        that cost is paid.  Cache hits and propagation verdicts serve at
+        every stage; probed-open boards consult the stage ladder, and a
+        shed verdict raises :class:`serving.brownout.BrownoutShed` for
+        ``saturation='reject'`` submits (the HTTP boundary turns it into
+        503/429 + Retry-After) while quiet-fallback callers — internal
+        work the node already accepted — degrade to the native-only
+        policy instead of erroring."""
         rec = trace.active()
         t0 = rec.now() if rec is not None else 0.0
         raw = self._raw_digest(job)
@@ -247,7 +259,35 @@ class FrontDoor:
             self._fill_cache(cf, raw, job)
             return True, None
 
-        easy = pr.score <= self.config.easy_score and self.native_available
+        # Brownout gate (serving/brownout.py): the stage ladder decides
+        # whether this tier is admitted at all, and whether the easy
+        # tier's device shadow is suppressed.  Disabled path = one global
+        # read + one branch (explode-microcheck pinned).
+        easy_tier = pr.score <= self.config.easy_score
+        ctrl = brownout.active()
+        action, bo_stage = (
+            ctrl.gate("easy" if easy_tier else "hard")
+            if ctrl is not None
+            else (brownout.SERVE, 0)
+        )
+        if action == brownout.SHED:
+            if saturation == "reject":
+                tier = "easy" if easy_tier else "hard"
+                ctrl.record_shed(tier, bo_stage)
+                if rec is not None:
+                    rec.record(
+                        job.uuid, "route", "frontdoor.route",
+                        rec.now(), node=self.engine.trace_node,
+                        route="shed", stage=bo_stage, tier=tier,
+                        score=pr.score,
+                    )
+                raise brownout.BrownoutShed(
+                    bo_stage, ctrl.retry_after_s(), tier, uuid=job.uuid
+                )
+            # Quiet callers degrade, never error: internal work the node
+            # already accepted serves at the stage-1 policy.
+            action = brownout.NATIVE_ONLY if easy_tier else brownout.SERVE
+        easy = easy_tier and self.native_available
         t2 = rec.now() if rec is not None else 0.0
         if rec is not None:
             rec.record(
@@ -268,6 +308,9 @@ class FrontDoor:
                 on_verdict=lambda j, cf=cf, raw=raw: self._native_verdict(
                     j, cf, raw
                 ),
+                # Stage >= 1: native-only — the device shadow's lanes go
+                # back to the hard tail.
+                device_fallback=action != brownout.NATIVE_ONLY,
             )
             return True, None
         job.route = "device"

@@ -110,7 +110,22 @@ served here is: ``POST /solve``, ``POST /solve_batch``, ``POST
   cluster latency quantiles from the merged histograms, the
   ``rpc_floor_ms`` estimate, and the SLO plane's state (``obs/agg.py``).
 * ``GET /slo`` — the SLO monitor's objectives, burn rates, and breach
-  counters (``obs/slo.py``); 404 unless the node runs with ``--slo``.
+  counters (``obs/slo.py``), plus the live per-objective ``burn``
+  snapshot (burn rate / headroom / windowed totals — the exact numbers
+  the brownout controller acts on); 404 unless the node runs with
+  ``--slo``.
+
+Since round 18 a **brownout controller** (``serving/brownout.py``, on by
+default with ``--slo``) closes the loop from the SLO plane back to
+admission: sustained burn / queue pressure walks an edge-triggered stage
+ladder that suppresses the easy tier's device shadow (stage 1), sheds
+the easy tier with ``503 + Retry-After`` (stage 2), and admits only
+cache/propagation answers (stage 3, ``429``).  Every shed response
+carries a machine-readable body ``{stage, retry_after_s, shed_tier}``
+and is recorded into the ``solve`` SLO stream as a NON-error.  The
+controller's stage/shed counters ride ``/metrics`` (``brownout``
+section), turn ``/status`` amber (``brownout_members``), and roll up
+cluster-wide via ``obs/agg.py``.
 * ``POST /profile`` ``{"secs": 1.0, "logdir": "..."} `` — a bounded
   ``jax.profiler`` device-trace window (``utils/profiling.py``); one
   window at a time (409 while open).
@@ -127,6 +142,7 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from distributed_sudoku_solver_tpu.obs import agg, slo, trace
+from distributed_sudoku_solver_tpu.serving.brownout import BrownoutShed
 from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
 from distributed_sudoku_solver_tpu.serving.scheduler import EngineSaturated
 
@@ -209,11 +225,40 @@ class _Handler(BaseHTTPRequestHandler):
                 job = node.submit(grid)
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
+            except BrownoutShed as e:
+                # Brownout load shedding (serving/brownout.py): the stage
+                # ladder refused this request's tier at the front door.
+                # The body is machine-readable ({stage, retry_after_s,
+                # shed_tier}), and the response is recorded into the
+                # `solve` SLO stream as a NON-error — shedding protects
+                # the error-rate objective, it must not burn it.
+                self._trace_http(rec, t_http, e.uuid or "", e.status)
+                self._record_solve(
+                    node, self._now() - start, e.status, shed=True
+                )
+                return self._send(
+                    e.status,
+                    {
+                        "error": str(e),
+                        "stage": e.stage,
+                        "retry_after_s": round(e.retry_after_s, 3),
+                        "shed_tier": e.shed_tier,
+                    },
+                    headers={
+                        "Retry-After": str(max(1, int(-(-e.retry_after_s // 1))))
+                    },
+                )
             except EngineSaturated as e:
                 # Resident-flight admission control (serving/scheduler.py):
                 # slot pool and bounded queue are full, so the node sheds
                 # load loudly instead of queueing unboundedly.  Retry-After
-                # is the scheduler's backlog-paced estimate.
+                # is the scheduler's backlog-paced estimate.  Recorded into
+                # the solve stream (429 < 500, so never an error): the SLO
+                # plane should see refused requests, not pretend the wall
+                # vanished.
+                self._record_solve(
+                    node, self._now() - start, 429, shed=True
+                )
                 return self._send(
                     429,
                     {
@@ -257,7 +302,8 @@ class _Handler(BaseHTTPRequestHandler):
             rec.record(job_uuid, "http.solve", "http", t0, status=status)
 
     @staticmethod
-    def _record_solve(node, duration: float, status: int) -> None:
+    def _record_solve(node, duration: float, status: int,
+                      shed: bool = False) -> None:
         """The http-solve wall (obs/hist.py ``solve_ms`` + the SLO
         ``solve`` stream): one sample per completed ``/solve`` whatever
         the status and whichever branch produced it (plain, portfolio,
@@ -266,13 +312,28 @@ class _Handler(BaseHTTPRequestHandler):
         (``solve_p95_ms<=...``).  5xx statuses — including a 504
         timeout, where the job merely got cancelled and carries no
         ``job.error`` — count as errors for ``error_rate``: the SLO
-        plane watches what the CLIENT saw, not what the engine felt."""
+        plane watches what the CLIENT saw, not what the engine felt.
+
+        ``shed=True`` marks deliberate load shedding (a brownout 503 or a
+        saturation 429): the response counts toward the error-rate
+        objective's totals but NEVER as an error — shedding exists to
+        protect that objective, and a 503 storm of honest refusals
+        burning the budget it was defending would make the controller
+        self-sustaining — and is excluded from latency objectives
+        outright, so a flood of ~1 ms refusals cannot dilute the latency
+        window and flap the ladder (both pinned in
+        tests/test_brownout.py).  The raw ``solve_ms`` histogram still
+        records every response: it documents what clients experienced,
+        shed answers included."""
         eng = getattr(node, "engine", None)
         if eng is not None:
             eng.hist["solve_ms"].record(duration)
         mon = slo.active()
         if mon is not None:
-            mon.observe(duration, error=status >= 500, stream="solve")
+            mon.observe(
+                duration, error=status >= 500 and not shed, stream="solve",
+                shed=shed,
+            )
 
     def _solve_count_all(self, node, grid, start, timeout):
         """``POST /solve`` with ``"count_all": true``: enumerate EVERY
@@ -526,7 +587,13 @@ class _Handler(BaseHTTPRequestHandler):
                     404,
                     {"error": "no SLO configured (start the node with --slo)"},
                 )
-            return self._send(200, mon.state())
+            # `burn` is the public burn_snapshot read API (ISSUE 15): the
+            # per-objective live burn/headroom the brownout controller
+            # consumes — surfaced so operators see the same numbers the
+            # admission policy acts on.
+            return self._send(
+                200, {**mon.state(), "burn": mon.burn_snapshot()}
+            )
         if path == "/trace" or path.startswith("/trace/"):
             return self._trace_view(path, query)
         return self._send(404, {"error": "not found"})

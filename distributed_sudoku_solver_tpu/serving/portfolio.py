@@ -126,6 +126,7 @@ def race_native(
     job: Job,
     head_start_s: float = 0.5,
     on_verdict: Optional[Callable[[Job], None]] = None,
+    device_fallback: bool = True,
 ) -> Job:
     """Race the native C++ DFS against a *delayed* device fallback on one
     pre-built job — the find-one twin of :func:`race_cover`, and the seam
@@ -160,6 +161,13 @@ def race_native(
     clock reads here: the deadline/latency math belongs to the caller,
     and the head start is a bounded ``Event.wait`` yield (the
     simnet-blessed idiom).
+
+    ``device_fallback=False`` (brownout stage 1, ``serving/brownout.py``)
+    runs the race **native-only**: the device shadow is never submitted,
+    reclaiming its device lanes for the hard tail.  A backstop thread
+    still settles the job if the native entrant declines or dies (a
+    500-able error — rare by construction, since the front door only
+    suppresses the fallback when ``native.available()`` held at boot).
     """
     # The settle lock guards ONLY the winner claim: the claiming thread
     # then fills the job, runs the verdict hook, and sets the done event
@@ -263,11 +271,24 @@ def race_native(
             cancelled=inner.cancelled,
         )
 
+    def backstop() -> None:
+        # Native-only mode: no device shadow exists, so a native decline
+        # (native_entrant returning without claiming) must still resolve
+        # the job — an unresolved done event would hang its waiter.
+        native_settled.wait()
+        if not job.done.is_set():
+            _finish(
+                "native",
+                error="native engine declined (device fallback suppressed "
+                "by brownout stage 1)",
+            )
+
     threading.Thread(
         target=native_entrant, daemon=True, name="frontdoor-native"
     ).start()
     threading.Thread(
-        target=device_entrant, daemon=True, name="frontdoor-native-fallback"
+        target=device_entrant if device_fallback else backstop,
+        daemon=True, name="frontdoor-native-fallback",
     ).start()
     return job
 

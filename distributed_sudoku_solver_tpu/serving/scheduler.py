@@ -367,6 +367,16 @@ class ResidentFlight:
                 1 for s in self.slots if s is not None
             )
 
+    def admission_pressure(self) -> tuple:
+        """``(queue_fraction, admission_wait_p95_s)`` — the brownout
+        controller's resident signals (``serving/brownout.py``): how full
+        the bounded admission queue is (1.0 = the next reject-mode submit
+        429s) and how long admitted jobs recently waited for a slot."""
+        with self._lock:
+            frac = len(self._pending) / float(self.rcfg.queue_depth)
+        aw = self.admission_wait.snapshot()
+        return frac, (aw["p95"] if aw else 0.0)
+
     def metrics(self) -> dict:
         with self._lock:
             occupied = sum(1 for s in self.slots if s is not None)

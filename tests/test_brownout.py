@@ -1,0 +1,651 @@
+"""Brownout controller (serving/brownout.py, ISSUE 15): the stage ladder
+on a fake clock, shedding through the real front door, the shed-response
+HTTP contract, and the disabled-path microcheck.
+
+Lanes:
+
+* **unit** — hysteresis enter/exit thresholds, exactly-once transitions,
+  re-arm after a quiet window, the gate policy matrix, broken signals.
+* **engine** — stage 2/3 shedding through ``SolverEngine`` + the front
+  door (reject vs quiet-fallback submits), stage-1 native-only (the
+  device shadow provably suppressed), the 504-storm e2e overload walk.
+* **http** — machine-readable shed bodies, Retry-After, and the pin that
+  shed responses never burn the error-rate objective they protect.
+* **microcheck** — with no controller installed the serving path never
+  touches the controller surface (one global read + branch).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.obs import slo
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.serving import brownout
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.frontdoor.router import FrontDoorConfig
+from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, make_puzzle
+
+SMALL = SolverConfig(min_lanes=8, stack_slots=24, max_steps=40_000)
+
+#: Probe-open, easy-scored boards (pinned by test_frontdoor's probe
+#: classification lane): seeds whose 30-clue puzzles stay open after
+#: propagation with branching slack under the default easy threshold.
+EASY_OPEN_SEEDS = (123, 148, 151, 152, 155, 156, 186)
+
+
+def _easy_open(i: int = 0) -> np.ndarray:
+    return make_puzzle(SUDOKU_9, seed=EASY_OPEN_SEEDS[i], n_clues=30)
+
+
+class FakeClock:
+    """Injectable clock: the ladder advances when the TEST says so."""
+
+    def __init__(self, t0: float = 1000.0):
+        self.t = t0
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self.t
+
+    def advance(self, dt: float) -> None:
+        with self._lock:
+            self.t += dt
+
+
+def _ctrl(clock, press, **cfg_kw):
+    defaults = dict(enter=1.0, exit=0.5, quiet_s=5.0, hold_s=1.0,
+                    eval_interval_s=0.0)
+    defaults.update(cfg_kw)
+    return brownout.BrownoutController(
+        brownout.BrownoutConfig(**defaults),
+        clock=clock,
+        signals={"burn": lambda: press[0]},
+    )
+
+
+# -- unit lane: the ladder on a fake clock -------------------------------------
+
+
+def test_config_rejects_inverted_hysteresis_band():
+    with pytest.raises(ValueError):
+        brownout.BrownoutConfig(enter=1.0, exit=1.0)
+    with pytest.raises(ValueError):
+        brownout.BrownoutConfig(enter=0.5, exit=0.8)
+
+
+def test_ladder_escalates_one_stage_per_hold_window():
+    clock, press = FakeClock(), [2.0]
+    ctrl = _ctrl(clock, press, hold_s=1.0)
+    assert ctrl.evaluate() == 1  # first crossing climbs immediately
+    # Inside the hold window: pressure stays high but the ladder dwells.
+    clock.advance(0.5)
+    assert ctrl.evaluate() == 1
+    clock.advance(0.6)
+    assert ctrl.evaluate() == 2
+    clock.advance(1.1)
+    assert ctrl.evaluate() == 3
+    # MAX_STAGE is the ceiling however long the storm lasts.
+    clock.advance(10.0)
+    assert ctrl.evaluate() == 3
+    assert ctrl.escalations == 3 and ctrl.transitions == 3
+    assert ctrl.stage_entered == [0, 1, 1, 1]
+
+
+def test_ladder_hysteresis_band_neither_climbs_nor_calms():
+    clock, press = FakeClock(), [2.0]
+    ctrl = _ctrl(clock, press, quiet_s=2.0)
+    ctrl.evaluate()
+    assert ctrl.stage() == 1
+    # Pressure drops into the band (exit < p < enter): stage holds and no
+    # calm accrues, however long it sits there.
+    press[0] = 0.75
+    for _ in range(10):
+        clock.advance(5.0)
+        assert ctrl.evaluate() == 1
+    # Only genuinely-calm readings de-escalate, and only after quiet_s of
+    # UNBROKEN calm — a band excursion resets the calm window.
+    press[0] = 0.2
+    clock.advance(1.0)
+    assert ctrl.evaluate() == 1  # calm just started
+    press[0] = 0.75
+    clock.advance(1.5)
+    assert ctrl.evaluate() == 1  # band visit wipes the accrued calm
+    press[0] = 0.2
+    clock.advance(1.0)
+    assert ctrl.evaluate() == 1
+    clock.advance(2.1)
+    assert ctrl.evaluate() == 0
+    assert ctrl.deescalations == 1 and ctrl.transitions == 2
+
+
+def test_ladder_full_cycle_counts_exactly_once_and_rearms():
+    clock, press = FakeClock(), [1.5]
+    ctrl = _ctrl(clock, press, hold_s=0.5, quiet_s=2.0)
+    for _ in range(5):
+        ctrl.evaluate()
+        clock.advance(0.6)
+    assert ctrl.stage() == 3
+    press[0] = 0.0
+    for _ in range(5):
+        clock.advance(2.1)
+        ctrl.evaluate()
+    assert ctrl.stage() == 0
+    assert ctrl.transitions == 6
+    assert ctrl.escalations == 3 and ctrl.deescalations == 3
+    # Re-arm: a second storm climbs the ladder again — fresh transitions,
+    # not a saturated one-shot alarm.
+    press[0] = 1.5
+    for _ in range(5):
+        ctrl.evaluate()
+        clock.advance(0.6)
+    assert ctrl.stage() == 3 and ctrl.escalations == 6
+    m = ctrl.metrics()
+    assert m["transitions"] == 9
+    # Both directions "enter" a stage: 2 storms x (1,2,3) + one walk-down
+    # through (2,1,0).
+    assert m["stage_entered"] == [1, 3, 3, 2]
+    assert sum(m["stage_residency_s"]) == pytest.approx(
+        clock() - 1000.0, abs=1e-6
+    )
+
+
+def test_gate_policy_matrix():
+    clock, press = FakeClock(), [2.0]
+    ctrl = _ctrl(clock, press, hold_s=0.0)
+    # Freeze evaluation so gate() reads a pinned stage per row.
+    expected = {
+        0: {"easy": brownout.SERVE, "hard": brownout.SERVE},
+        1: {"easy": brownout.NATIVE_ONLY, "hard": brownout.SERVE},
+        2: {"easy": brownout.SHED, "hard": brownout.SERVE},
+        3: {"easy": brownout.SHED, "hard": brownout.SHED},
+    }
+    press[0] = 0.75  # hysteresis band: stage frozen between forced climbs
+    for stage in range(4):
+        for tier, want in expected[stage].items():
+            action, got_stage = ctrl.gate(tier)
+            assert (action, got_stage) == (want, stage), (stage, tier)
+        if stage < 3:
+            press[0] = 2.0
+            ctrl.evaluate()
+            press[0] = 0.75
+    # Shed statuses: 503 only at stage 2, 429 at stage 3.
+    assert brownout.BrownoutShed(2, 1.0, "easy").status == 503
+    assert brownout.BrownoutShed(3, 1.0, "hard").status == 429
+
+
+def test_floor_signal_reads_zero_on_an_undrifted_link():
+    """Review finding: the floor signal is normalized over the DRIFT —
+    recent == lifetime min reads 0.0 pressure (no structural baseline
+    that could trap a low --brownout-exit in a permanent shed state),
+    and recent == floor_drift x min reads exactly 1.0."""
+    class _Floor:
+        def __init__(self, d):
+            self._d = d
+
+        def to_dict(self):
+            return self._d
+
+    class _Eng:
+        def __init__(self, d):
+            self.rpc_floor = _Floor(d)
+
+        def _resident_flights(self):
+            return []
+
+    cfg = brownout.BrownoutConfig(floor_drift=4.0)
+    sig = brownout.engine_signals(
+        _Eng({"type": "min_est", "min": 50.0, "recent": 50.0}), cfg
+    )["floor"]
+    assert sig() == 0.0
+    sig = brownout.engine_signals(
+        _Eng({"type": "min_est", "min": 50.0, "recent": 200.0}), cfg
+    )["floor"]
+    assert sig() == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        brownout.BrownoutConfig(floor_drift=1.0)
+
+
+def test_broken_or_empty_signals_read_as_silence():
+    clock = FakeClock()
+
+    def explode():
+        raise RuntimeError("signal backend gone")
+
+    ctrl = brownout.BrownoutController(
+        brownout.BrownoutConfig(eval_interval_s=0.0),
+        clock=clock,
+        signals={"burn": explode, "queue": lambda: None},
+    )
+    assert ctrl.evaluate() == 0  # no usable signal = pressure 0, not a crash
+    assert ctrl.metrics()["pressure"] == {}
+
+
+def test_shed_observations_count_error_rate_total_but_skip_latency():
+    """The shed-observation contract both ways (review finding): a shed
+    response feeds the error-rate objective's TOTAL (as a non-error —
+    refusals dilute the error fraction honestly) but is excluded from
+    latency objectives entirely, so a storm of ~1 ms refusals cannot
+    collapse the latency burn signal and flap the ladder that produced
+    them."""
+    clock = FakeClock()
+    mon = slo.SloMonitor(
+        slo.parse_slo("error_rate<=0.1,solve_p95_ms<=250"),
+        window_s=60.0, clock=clock, min_samples=1,
+    )
+    for _ in range(5):
+        mon.observe(300.0 / 1e3, error=False, stream="solve")  # slow serves
+    for _ in range(50):
+        mon.observe(0.001, error=False, stream="solve", shed=True)
+    snap = mon.burn_snapshot()
+    lat = snap["solve_p95_ms<=250"]
+    # 5 served observations, all over threshold — the 50 refusals did not
+    # dilute the window.
+    assert lat["window_total"] == 5 and lat["window_bad"] == 5
+    assert lat["burning"]
+    err = snap["error_rate<=0.1"]
+    assert err["window_total"] == 55 and err["window_bad"] == 0
+    # And a shed can never be an error, whatever the caller passed.
+    mon.observe(0.001, error=True, stream="solve", shed=True)
+    assert mon.burn_snapshot()["error_rate<=0.1"]["window_bad"] == 0
+
+
+def test_burn_snapshot_read_api_and_decay():
+    clock = FakeClock()
+    mon = slo.SloMonitor(
+        slo.parse_slo("error_rate<=0.1,solve_p95_ms<=250"),
+        window_s=12.0, clock=clock,
+    )
+    for _ in range(20):
+        mon.observe(0.01, error=True, stream="solve")
+    snap = mon.burn_snapshot()
+    err = snap["error_rate<=0.1"]
+    assert err["burn_rate"] == pytest.approx(10.0)
+    assert err["headroom"] == pytest.approx(1.0 - 10.0)
+    assert err["burning"] and err["window_total"] == 20
+    assert err["window_bad"] == 20
+    lat = snap["solve_p95_ms<=250"]
+    assert lat["burn_rate"] == 0.0 and not lat["burning"]
+    # The snapshot decays without traffic: the window ages out on reads.
+    clock.advance(15.0)
+    snap2 = mon.burn_snapshot()
+    assert snap2["error_rate<=0.1"]["burn_rate"] == 0.0
+    assert snap2["error_rate<=0.1"]["window_total"] == 0
+
+
+# -- engine lane: shedding through the real front door -------------------------
+
+
+def _engine(**kw):
+    return SolverEngine(
+        config=SMALL, max_batch=8, chunk_steps=8,
+        frontdoor=FrontDoorConfig(), **kw,
+    ).start()
+
+
+def test_stage2_sheds_easy_stage3_sheds_hard_cache_always_serves():
+    clock, press = FakeClock(), [0.0]
+    ctrl = _ctrl(clock, press, hold_s=0.0, quiet_s=1.0)
+    eng = _engine()
+    try:
+        with brownout.installed(ctrl):
+            # Healthy: both tiers serve (and the hard verdict fills the
+            # canonical cache for the stage-3 assertion below).
+            j_easy = eng.submit(_easy_open(0), saturation="reject")
+            assert j_easy.wait(120) and j_easy.solved, j_easy.error
+            j_hard = eng.submit(np.asarray(HARD_9[1]), saturation="reject")
+            assert j_hard.wait(300) and j_hard.solved, j_hard.error
+            # Force stage 2, then hold it inside the hysteresis band.
+            press[0] = 2.0
+            ctrl.evaluate()
+            ctrl.evaluate()
+            press[0] = 0.75
+            assert ctrl.stage() == 2
+            with pytest.raises(brownout.BrownoutShed) as exc:
+                eng.submit(_easy_open(1), saturation="reject")
+            assert exc.value.status == 503 and exc.value.shed_tier == "easy"
+            assert exc.value.retry_after_s > 0
+            # The hard tail still serves at stage 2.
+            j2 = eng.submit(np.asarray(HARD_9[2]), saturation="reject")
+            assert j2.wait(300) and j2.solved, j2.error
+            # Stage 3: anything costing a dispatch is refused with 429...
+            press[0] = 2.0
+            ctrl.evaluate()
+            press[0] = 0.75
+            assert ctrl.stage() == 3
+            with pytest.raises(brownout.BrownoutShed) as exc3:
+                eng.submit(np.asarray(HARD_9[0]), saturation="reject")
+            assert exc3.value.status == 429 and exc3.value.shed_tier == "hard"
+            # ...but a cache hit costs nothing and serves even at stage 3.
+            jc = eng.submit(np.asarray(HARD_9[1]), saturation="reject")
+            assert jc.wait(60) and jc.solved and jc.route == "cache"
+            m = ctrl.metrics()
+            assert m["shed"] == {"easy": 1, "hard": 1}
+            assert m["shed_by_stage"][2] == 1 and m["shed_by_stage"][3] == 1
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_quiet_fallback_submits_degrade_instead_of_shedding():
+    """Internal callers (cluster re-execution, library users) never see a
+    BrownoutShed: at shed stages their easy boards run native-only and
+    their hard boards still reach the device."""
+    clock, press = FakeClock(), [2.0]
+    ctrl = _ctrl(clock, press, hold_s=0.0)
+    eng = _engine()
+    try:
+        with brownout.installed(ctrl):
+            for _ in range(3):
+                ctrl.evaluate()
+            press[0] = 0.75
+            assert ctrl.stage() == 3
+            j_easy = eng.submit(_easy_open(2))  # default saturation=fallback
+            assert j_easy.wait(120) and j_easy.done.is_set()
+            assert j_easy.route in ("native", "propagation")
+            j_hard = eng.submit(np.asarray(HARD_9[0]))
+            assert j_hard.wait(300) and j_hard.solved, j_hard.error
+            assert ctrl.metrics()["shed_total"] == 0
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_stage1_native_only_suppresses_device_shadow(monkeypatch):
+    """Stage 1 reclaims the easy tier's device lanes: the race's shadow
+    fallback is provably never submitted, while stage 0 still submits it
+    once the native head start elapses."""
+    from distributed_sudoku_solver_tpu import native
+
+    if not native.available():  # pragma: no cover - no compiler
+        pytest.skip("native DFS unavailable")
+    clock, press = FakeClock(), [0.0]
+    ctrl = _ctrl(clock, press, hold_s=0.0, quiet_s=1.0)
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, chunk_steps=8,
+        frontdoor=FrontDoorConfig(native_head_start_s=0.05),
+    ).start()
+    shadows = []
+    real_submit = eng.submit
+
+    def counting_submit(*a, **kw):
+        if kw.get("shadow"):
+            shadows.append(kw)
+        return real_submit(*a, **kw)
+
+    monkeypatch.setattr(eng, "submit", counting_submit)
+    # Hold the native entrant so the head start always elapses first —
+    # deterministic either way, instead of racing a fast native win.
+    release = threading.Event()
+    real_solve = native.solve
+
+    def slow_solve(grid, geom=None):
+        release.wait(5.0)
+        return real_solve(grid, geom) if geom is not None else real_solve(grid)
+
+    monkeypatch.setattr(native, "solve", slow_solve)
+    try:
+        with brownout.installed(ctrl):
+            press[0] = 2.0
+            ctrl.evaluate()
+            press[0] = 0.75
+            assert ctrl.stage() == 1
+            job = eng.submit(_easy_open(3), saturation="reject")
+            assert job.route == "native"  # admitted, racing native-only
+            release.set()
+            assert job.wait(120) and job.solved, job.error
+            assert job.route == "native"
+            assert shadows == [], "stage 1 submitted a device shadow"
+            # Stage 0 twin: same race, fallback allowed — the shadow IS
+            # submitted after the head start.
+            release.clear()
+            press[0] = 0.0
+            for _ in range(3):
+                clock.advance(2.0)
+                ctrl.evaluate()
+            assert ctrl.stage() == 0
+            job0 = eng.submit(_easy_open(4), saturation="reject")
+            deadline = threading.Event()
+            for _ in range(100):
+                if shadows:
+                    break
+                deadline.wait(0.05)
+            release.set()
+            assert job0.wait(120) and job0.done.is_set()
+            assert shadows, "stage 0 never submitted the device fallback"
+    finally:
+        release.set()
+        eng.stop(timeout=2)
+
+
+def test_native_only_backstop_resolves_a_decline(monkeypatch):
+    """With the fallback suppressed, a native decline must still resolve
+    the job (an error, not a hang)."""
+    from distributed_sudoku_solver_tpu import native
+    from distributed_sudoku_solver_tpu.serving.engine import Job
+    from distributed_sudoku_solver_tpu.serving.portfolio import race_native
+
+    monkeypatch.setattr(native, "available", lambda: False)
+    from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9 as G
+
+    eng = SolverEngine(config=SMALL, max_batch=8, chunk_steps=8).start()
+    try:
+        job = Job(uuid="bo-backstop", grid=_easy_open(0), geom=G)
+        job.submitted_at = eng._clock()
+        race_native(eng, job, head_start_s=0.01, device_fallback=False)
+        assert job.wait(10), "backstop never resolved the declined race"
+        assert not job.solved and job.error is not None
+        assert "declined" in job.error
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_e2e_504_storm_walks_ladder_up_and_back_down():
+    """The overload acceptance (ISSUE 15): a seeded 504-storm burns the
+    solve stream, the ladder walks 1 -> 2 -> 3, only easy-tier jobs shed
+    while ZERO hard-tier jobs are lost (every hard submit either solves
+    or gets an honest BrownoutShed), and recovery walks back to 0 with
+    every transition counted exactly once."""
+    clock = FakeClock()
+    mon = slo.SloMonitor(
+        slo.parse_slo("error_rate<=0.05"), window_s=10.0, clock=clock
+    )
+    ctrl = brownout.BrownoutController(
+        brownout.BrownoutConfig(
+            enter=1.0, exit=0.5, quiet_s=2.0, hold_s=0.5, eval_interval_s=0.0
+        ),
+        clock=clock,
+    )
+    eng = _engine()
+    ctrl.set_signals(brownout.engine_signals(eng, ctrl.config))
+    hard_outcomes = []
+    try:
+        with slo.installed(mon), brownout.installed(ctrl):
+            # Baseline: healthy traffic, stage 0, hard board solves (and
+            # fills the cache for the recovery phase).
+            j = eng.submit(np.asarray(HARD_9[1]), saturation="reject")
+            assert j.wait(300) and j.solved, j.error
+            hard_outcomes.append("solved")
+            # The storm: clients time out (HTTP 504s recorded as errors
+            # on the solve stream, exactly what serving/http.py does).
+            for _ in range(30):
+                mon.observe(0.3, error=True, stream="solve")
+            stages_seen = []
+            for _ in range(6):
+                stages_seen.append(ctrl.evaluate())
+                clock.advance(0.6)
+            assert stages_seen[-1] == 3 and ctrl.stage_entered[1:] == [1, 1, 1]
+            # Stage 3: easy AND hard shed honestly — never silently lost.
+            with pytest.raises(brownout.BrownoutShed) as e_easy:
+                eng.submit(_easy_open(5), saturation="reject")
+            assert e_easy.value.shed_tier == "easy"
+            with pytest.raises(brownout.BrownoutShed) as e_hard:
+                eng.submit(np.asarray(HARD_9[0]), saturation="reject")
+            hard_outcomes.append(f"shed:{e_hard.value.status}")
+            assert e_hard.value.status == 429
+            # Recovery: the window ages the errors out; quiet windows walk
+            # the ladder down one stage at a time.
+            clock.advance(12.0)
+            down = []
+            for _ in range(6):
+                clock.advance(2.1)
+                down.append(ctrl.evaluate())
+            assert down[-1] == 0 and ctrl.stage() == 0
+            assert ctrl.transitions == 6
+            assert ctrl.escalations == 3 and ctrl.deescalations == 3
+            # Back to serving: the hard tier answers again (cache hit —
+            # zero hard-tier verdicts were lost across the excursion).
+            j2 = eng.submit(np.asarray(HARD_9[1]), saturation="reject")
+            assert j2.wait(60) and j2.solved
+            hard_outcomes.append("solved")
+            assert all(
+                o == "solved" or o.startswith("shed:") for o in hard_outcomes
+            )
+            m = ctrl.metrics()
+            assert m["shed"] == {"easy": 1, "hard": 1}
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_disabled_path_microcheck(monkeypatch):
+    """No controller installed: the serving path must never touch the
+    controller surface — gate/evaluate monkeypatched to explode, a solve
+    still runs (the disabled path is one global read + one branch)."""
+    def explode(*a, **kw):  # pragma: no cover - must never run
+        raise AssertionError("brownout surface touched with no controller")
+
+    monkeypatch.setattr(brownout.BrownoutController, "gate", explode)
+    monkeypatch.setattr(brownout.BrownoutController, "evaluate", explode)
+    monkeypatch.setattr(brownout.BrownoutController, "stage", explode)
+    assert brownout.active() is None
+    eng = _engine()
+    try:
+        j = eng.submit(_easy_open(6), saturation="reject")
+        assert j.wait(120) and j.done.is_set()
+        assert "brownout" not in eng.metrics()
+    finally:
+        eng.stop(timeout=2)
+
+
+# -- http lane: the shed-response contract -------------------------------------
+
+
+def test_http_shed_body_retry_after_and_slo_non_error():
+    """Satellite pin (ISSUE 15): every shed response carries the
+    machine-readable body {stage, retry_after_s, shed_tier} + Retry-After,
+    and is recorded into the `solve` SLO stream as a NON-error — shedding
+    must not burn the error-rate objective it exists to protect."""
+    from distributed_sudoku_solver_tpu.serving.http import (
+        ApiServer,
+        StandaloneNode,
+    )
+
+    clock, press = FakeClock(), [0.0]
+    ctrl = _ctrl(clock, press, hold_s=0.0, retry_after_s=7.0)
+    mon = slo.SloMonitor(
+        slo.parse_slo("error_rate<=0.5,solve_p95_ms<=250"),
+        window_s=60.0, min_samples=1,
+    )
+    eng = _engine()
+    api = ApiServer(StandaloneNode(eng), host="127.0.0.1", port=0).start()
+    try:
+        with slo.installed(mon), brownout.installed(ctrl):
+            press[0] = 2.0
+            ctrl.evaluate()
+            ctrl.evaluate()
+            press[0] = 0.75
+            assert ctrl.stage() == 2
+            body = json.dumps(
+                {"sudoku": _easy_open(1).tolist()}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{api.port}/solve", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            e = err.value
+            assert e.code == 503
+            assert e.headers["Retry-After"] == "7"
+            shed_body = json.loads(e.read())
+            assert shed_body["stage"] == 2
+            assert shed_body["shed_tier"] == "easy"
+            assert shed_body["retry_after_s"] == pytest.approx(7.0)
+            # The pin: observed on the solve stream, NOT as an error —
+            # and excluded from the latency objective's window entirely.
+            objectives = mon.metrics()["objectives"]
+            state = objectives["error_rate<=0.5"]
+            assert state["window_total"] >= 1
+            assert state["window_bad"] == 0, (
+                "a 503 shed burned the error-rate objective it protects"
+            )
+            assert objectives["solve_p95_ms<=250"]["window_total"] == 0, (
+                "a shed response diluted the latency objective's window"
+            )
+            # /slo surfaces the burn snapshot the controller acts on.
+            raw = urllib.request.urlopen(
+                f"http://127.0.0.1:{api.port}/slo", timeout=30
+            ).read()
+            doc = json.loads(raw)
+            assert "burn" in doc
+            assert doc["burn"]["error_rate<=0.5"]["burn_rate"] == 0.0
+    finally:
+        api.stop()
+        eng.stop(timeout=2)
+
+
+# -- rollup / status lane ------------------------------------------------------
+
+
+def test_agg_rollup_merges_brownout_and_status_turns_amber():
+    from distributed_sudoku_solver_tpu.obs import agg
+
+    def body(stage, shed_easy, transitions):
+        return {
+            "brownout": {
+                "stage": stage,
+                "transitions": transitions,
+                "escalations": transitions,
+                "deescalations": 0,
+                "shed_total": shed_easy,
+                "shed": {"easy": shed_easy, "hard": 0},
+                "stage_residency_s": [10.0, 2.0, 1.0, 0.0],
+            }
+        }
+
+    ru = agg.rollup([body(0, 0, 0), body(2, 5, 2), body(1, 3, 1)])
+    bo = ru["brownout"]
+    assert bo["stage_max"] == 2 and bo["browning_members"] == 2
+    assert bo["transitions"] == 3 and bo["shed_total"] == 8
+    assert bo["shed"] == {"easy": 8, "hard": 0}
+    assert bo["stage_residency_s"] == [30.0, 6.0, 3.0, 0.0]
+
+    view = {
+        "address": "a:1", "coordinator": "a:1", "view": [0, 1],
+        "nodes": {
+            "a:1": {"unreachable": False, "stale": False,
+                    "metrics": body(0, 0, 0)},
+            "b:2": {"unreachable": False, "stale": False,
+                    "metrics": body(2, 5, 2)},
+        },
+        "rollup": ru,
+    }
+    status = agg.status_from(view)
+    assert status["brownout_members"] == ["b:2"]
+    assert status["state"] == "amber"
+    assert status["healthy"]  # amber is shedding-by-choice, not an outage
+    # No brownout anywhere: green.
+    view["nodes"]["b:2"]["metrics"] = body(0, 0, 0)
+    assert agg.status_from(view)["state"] == "green"
+    # A burning member outranks amber: red.
+    view["nodes"]["b:2"]["metrics"] = {
+        **body(3, 9, 3), "slo": {"burning": True},
+    }
+    st = agg.status_from(view)
+    assert st["state"] == "red" and st["brownout_members"] == ["b:2"]

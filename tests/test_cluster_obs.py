@@ -625,8 +625,9 @@ def test_regress_labels_cold_cache_runs(tmp_path, capsys):
 
 
 def test_bench_artifact_schema_matches_regress_expectations():
-    """The artifact bench_poisson --out-json writes and the gate's schema
-    constant must not drift apart (they live in different files)."""
+    """The artifacts bench_poisson writes (--out-json AND the round-18
+    --workload-out trace) and the consumers' schema constants must not
+    drift apart (they live in different files)."""
     import re
 
     src = open(
@@ -635,8 +636,15 @@ def test_bench_artifact_schema_matches_regress_expectations():
             "benchmarks", "bench_poisson.py",
         )
     ).read()
-    m = re.search(r'"schema": "([^"]+)"', src)
-    assert m and m.group(1) == _load_regress().SCHEMA
+    schemas = set(re.findall(r'"schema": "([^"]+)"', src))
+    assert _load_regress().SCHEMA in schemas
+    from benchmarks.replay import WORKLOAD_SCHEMA
+
+    assert WORKLOAD_SCHEMA in schemas
+    # And the replay artifact's schema is the one regress.py compares.
+    from benchmarks.replay import SCHEMA as REPLAY_SCHEMA
+
+    assert REPLAY_SCHEMA == _load_regress().REPLAY_SCHEMA
 
 
 # -- simnet acceptance ---------------------------------------------------------

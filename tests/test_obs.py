@@ -348,6 +348,26 @@ PROM_SAMPLE = {
             },
         },
     },
+    # Round-18 brownout section (serving/brownout.py): stage gauge,
+    # transition counters, per-tier shed counts as a `tier`-labeled
+    # table, residency/entered vectors (index label), and the last
+    # evaluated pressure readings.
+    "brownout": {
+        "stage": 1,
+        "enter": 1.0,
+        "exit": 0.5,
+        "quiet_s": 15.0,
+        "transitions": 3,
+        "escalations": 2,
+        "deescalations": 1,
+        "stage_entered": [0, 2, 1, 0],
+        "stage_residency_s": [42.5, 3.25, 1.5, 0.0],
+        "shed_total": 4,
+        "shed": {"easy": 3, "hard": 1},
+        "shed_by_stage": [0, 0, 3, 1],
+        "pressure": {"burn": 1.31, "queue": 0.25, "wait": 0.1,
+                     "floor": 0.27},
+    },
     # Round-15 sections: the compile watch (per-program counts/walls as
     # a `program`-labeled table + alarm state), the cost plane (per-
     # program flops/bytes + the efficiency gauge), and critical-path
@@ -493,6 +513,8 @@ def test_promck_over_live_prometheus_endpoint():
     from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
     from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
 
+    from distributed_sudoku_solver_tpu.serving import brownout
+
     rec = trace.TraceRecorder(ring=4096)
     watch = compilewatch.CompileWatch(warmup_s=3600.0)
     mon = critpath.CritPathMonitor()
@@ -500,10 +522,12 @@ def test_promck_over_live_prometheus_endpoint():
         config=SMALL, max_batch=8, chunk_steps=4,
         frontdoor=FrontDoorConfig(),
     ).start()
+    ctrl = brownout.BrownoutController()
+    brownout.bind_engine(ctrl, eng)
     api = ApiServer(StandaloneNode(eng), host="127.0.0.1", port=0).start()
     try:
         with trace.installed(rec), compilewatch.installed(watch), \
-                critpath.installed(mon):
+                critpath.installed(mon), brownout.installed(ctrl):
             j = eng.submit(HARD_9[1])  # hard tail: device route
             assert j.wait(120) and j.solved, j.error
             je = eng.submit(np.asarray(EASY_9))  # propagation route
@@ -548,6 +572,13 @@ def test_promck_over_live_prometheus_endpoint():
     assert "dsst_frontdoor_cache_canonical_dups 1" in raw
     assert 'dsst_hist_frontdoor_cache_ms_bucket{le="+Inf"} 1' in raw
     assert 'dsst_hist_frontdoor_device_ms_bucket{le="+Inf"} 1' in raw
+    # Round-18 brownout families (serving/brownout.py): the stage gauge,
+    # the tier-labeled shed table, and the transition counters render
+    # from the LIVE controller (healthy here: stage 0, nothing shed).
+    assert "dsst_brownout_stage 0" in raw
+    assert 'dsst_brownout_shed{tier="easy"} 0' in raw
+    assert 'dsst_brownout_shed{tier="hard"} 0' in raw
+    assert "dsst_brownout_transitions 0" in raw
 
 
 # -- simnet acceptance ---------------------------------------------------------

@@ -1,0 +1,405 @@
+"""Trace-replay capacity planner (benchmarks/replay.py, ISSUE 15): the
+deterministic simnet lane — byte-identical seeded artifacts, the overload
+soak that walks the brownout ladder 1 -> 2 -> 3 and back to 0 with zero
+lost jobs, capacity scaling, and the regress.py dsst-replay/1 rules.
+
+The workload fixtures are hand-built ``dsst-workload/1`` docs (the exact
+shape ``bench_poisson --workload-out`` records — pinned against the
+recorder by the slow-lane integration test at the bottom), so the fast
+lane never pays an engine boot.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from benchmarks import regress
+from benchmarks.replay import SCHEMA, WORKLOAD_SCHEMA, replay
+from distributed_sudoku_solver_tpu.serving import brownout
+
+BENCH_PARAMS = {
+    "jobs": 80, "mean_gap_ms": 50.0, "handicap_ms": 50.0,
+    "chunk_steps": 8, "seed": 7,
+}
+
+
+def _workload(n=80, device_every=4, device_wall_ms=2000.0,
+              easy_wall_ms=5.0, gap_ms=50.0, slots=2, queue_depth=8):
+    """Synthetic trace: easy native traffic with a device job every
+    ``device_every`` arrivals — the overload dial is the ratio of device
+    service time to slots x gap."""
+    jobs = []
+    t = 0.0
+    for i in range(n):
+        if i % device_every == 0:
+            jobs.append({
+                "offset_ms": round(t, 3), "tier": "hard", "board": [[0]],
+                "route": "device", "wall_ms": device_wall_ms,
+                "solved": True, "unsat": False,
+            })
+        else:
+            jobs.append({
+                "offset_ms": round(t, 3), "tier": "easy", "board": [[0]],
+                "route": "native", "wall_ms": easy_wall_ms,
+                "solved": True, "unsat": False,
+            })
+        t += gap_ms
+    return {
+        "schema": WORKLOAD_SCHEMA,
+        "params": dict(BENCH_PARAMS, jobs=n),
+        "engine": "resident",
+        "job_slots": slots,
+        "queue_depth": queue_depth,
+        "jobs_trace": jobs,
+    }
+
+
+@pytest.mark.simnet
+def test_two_seeded_replays_are_byte_identical():
+    """The determinism pin (ISSUE 15 satellite): same trace, same seed,
+    same knobs -> byte-identical artifacts, including the brownout
+    stage walk and shed accounting."""
+    wl = _workload()
+    a1 = replay(wl, nodes=1, seed=3)
+    a2 = replay(wl, nodes=1, seed=3)
+    assert json.dumps(a1, sort_keys=True) == json.dumps(a2, sort_keys=True)
+    assert a1["schema"] == SCHEMA
+
+
+@pytest.mark.simnet
+def test_overload_soak_walks_ladder_and_loses_nothing():
+    """The acceptance soak: a seeded overload drives the controller
+    through stage 1 -> 2 -> 3 and back to 0, zero jobs lost overall
+    (completed + shed == offered; shed jobs carry honest 429/503
+    statuses, never silent drops), transitions exactly-once counted."""
+    wl = _workload()
+    art = replay(wl, nodes=1, seed=3)
+    # The ladder climbed to the top and recovered through the cooldown.
+    assert art["max_stage"] == 3 and art["brownout_engaged"]
+    assert art["final_stages"] == [0]
+    # One full cycle: 3 escalations + 3 de-escalations, exactly once.
+    assert art["transitions"] == 6
+    # Zero lost: every offered job either completed or was shed honestly.
+    assert art["completed"] + art["shed"]["total"] == art["jobs"]
+    assert art["shed"]["total"] > 0
+    assert set(art["shed"]["by_status"]) <= {"503", "429"}
+    assert sum(art["shed"]["by_status"].values()) == art["shed"]["total"]
+    # Stage 2 shed easy-tier 503s before stage 3's 429s — the
+    # value-ordered ladder, not random drops.
+    assert art["shed"]["by_tier"].get("easy", 0) > 0
+    # Residency covers the whole virtual run, stages > 0 included.
+    assert sum(art["stage_residency_s"][1:]) > 0
+
+
+@pytest.mark.simnet
+def test_ample_capacity_never_engages_brownout():
+    """The capacity question inverted: enough slots -> the same traffic
+    replays without the controller ever leaving stage 0, and predicted
+    walls equal the recorded walls exactly (service model = recorded
+    wall, uncontended)."""
+    wl = _workload(slots=64)
+    art = replay(wl, nodes=1, seed=3)
+    assert not art["brownout_engaged"] and art["max_stage"] == 0
+    assert art["shed"]["total"] == 0
+    assert art["completed"] == art["jobs"]
+    assert art["transitions"] == 0
+    # Uncontended replay reproduces the trace bit-for-bit.
+    assert art["tiers"]["hard"]["p95_ms"] == 2000.0
+    assert art["tiers"]["easy"]["p95_ms"] == 5.0
+
+
+@pytest.mark.simnet
+def test_fleet_scaling_relieves_the_single_node():
+    """The capacity experiment this harness exists for: the overloaded
+    1-node replay sheds; the same trace over a 4-node fleet (least-
+    outstanding routing) sheds nothing."""
+    wl = _workload()
+    one = replay(wl, nodes=1, seed=3)
+    four = replay(wl, nodes=4, seed=3)
+    assert one["shed"]["total"] > 0
+    assert four["shed"]["total"] == 0
+    assert four["completed"] == four["jobs"]
+    assert four["params"]["nodes"] == 4
+
+
+@pytest.mark.simnet
+def test_bounded_queue_answers_saturation_429():
+    """The model's admission queue is really bounded (review finding):
+    device jobs beyond slots + queue_depth are refused with the
+    saturation 429 — they never 'complete' with queueing walls real
+    clients would have been 429'd before paying."""
+    wl = _workload(n=16, device_every=1, device_wall_ms=5000.0,
+                   gap_ms=10.0, slots=1, queue_depth=2)
+    art = replay(
+        wl, nodes=1, seed=0,
+        # Generous SLO: every refusal below must be SATURATION, not a
+        # brownout stage shed.
+        slo_spec="solve_p95_ms<=600000,error_rate<=0.5",
+    )
+    assert art["completed"] + art["shed"]["total"] == art["jobs"]
+    assert art["shed"]["by_tier"].get("saturated", 0) > 0
+    assert art["shed"]["by_status"] == {"429": art["shed"]["total"]}
+    # slots(1) + queue(2) in service/waiting at the burst peak; the rest
+    # of the burst refused.
+    assert art["completed"] < art["jobs"]
+
+
+@pytest.mark.simnet
+def test_gate_tier_uses_recorded_tier_not_final_route():
+    """An easy-generated board whose device shadow won the recorded race
+    (tier='easy', route='device') is still probe-easy: at stage 2 the
+    replay sheds it with 503 instead of admitting it to a device slot
+    (review finding)."""
+    wl = _workload()  # drives the single node to stage 2+ mid-traffic
+    for j in wl["jobs_trace"]:
+        if j["route"] == "device":
+            j["tier"] = "easy"  # the shadow-won-the-race shape
+    art = replay(wl, nodes=1, seed=3)
+    assert art["max_stage"] >= 2
+    # Every brownout shed is easy-tier now (the only hard candidates are
+    # gone), and stage-2 503s exist — route='device' did not smuggle the
+    # easy boards past the easy-tier gate.
+    assert art["shed"]["by_tier"].get("hard", 0) == 0
+    assert art["shed"]["by_tier"].get("easy", 0) > 0
+    assert art["shed"]["by_status"].get("503", 0) > 0
+
+
+# -- regress.py dsst-replay/1 rules --------------------------------------------
+
+
+def _live_artifact(tiers=None, resident_p95=2000.0, params=None):
+    doc = {
+        "schema": regress.SCHEMA,
+        "params": dict(params if params is not None else BENCH_PARAMS),
+        "static": {"p50_ms": 1.0, "p95_ms": 2.0},
+        "resident": {"p50_ms": 1.0, "p95_ms": resident_p95},
+    }
+    if tiers is not None:
+        doc["resident"]["tiers"] = tiers
+    return doc
+
+
+def _replay_artifact(tiers, workload_params=None, nodes=1, rate_x=1.0,
+                     shed_total=0):
+    return {
+        "schema": SCHEMA,
+        "params": {
+            "workload": dict(
+                workload_params if workload_params is not None
+                else BENCH_PARAMS
+            ),
+            "nodes": nodes, "slots": 8, "queue_depth": 64,
+            "rate_x": rate_x, "seed": 0,
+            "slo": "solve_p95_ms<=2000,error_rate<=0.01",
+            "brownout": {"enter": 1.0, "exit": 0.5, "quiet_s": 5.0},
+        },
+        "jobs": 48, "completed": 48 - shed_total,
+        "shed": {"total": shed_total, "by_tier": {}, "by_status": {}},
+        "overall": {"p50_ms": 10.0, "p95_ms": 1900.0},
+        "tiers": tiers,
+        "routes": {},
+        "stage_residency_s": [100.0, 0.0, 0.0, 0.0],
+        "transitions": 0, "max_stage": 0, "final_stages": [0],
+        "brownout_engaged": False,
+    }
+
+
+def _run(tmp_path, replay_doc, live_doc, order=("replay", "live"), tol=None):
+    pr = tmp_path / "replay.json"
+    pl = tmp_path / "live.json"
+    pr.write_text(json.dumps(replay_doc))
+    pl.write_text(json.dumps(live_doc))
+    paths = {"replay": str(pr), "live": str(pl)}
+    argv = [paths[order[0]], paths[order[1]]]
+    if tol is not None:
+        argv += ["--tol", str(tol)]
+    return regress.main(argv)
+
+
+def test_regress_replay_within_band_passes_either_order(tmp_path, capsys):
+    tiers = {"easy": {"p95_ms": 5.0}, "hard": {"p95_ms": 2100.0}}
+    live = _live_artifact(tiers={"easy": {"p95_ms": 5.5},
+                                 "hard": {"p95_ms": 2000.0}})
+    rep = _replay_artifact(tiers)
+    assert _run(tmp_path, rep, live) == 0
+    assert "replay prediction within" in capsys.readouterr().out
+    assert _run(tmp_path, rep, live, order=("live", "replay")) == 0
+
+
+def test_regress_replay_out_of_band_is_a_misprediction(tmp_path, capsys):
+    rep = _replay_artifact({"hard": {"p95_ms": 4000.0}})
+    live = _live_artifact(tiers={"hard": {"p95_ms": 2000.0}})
+    assert _run(tmp_path, rep, live) == 1
+    assert "MISPREDICTION" in capsys.readouterr().err
+    # Two-sided: a wildly optimistic prediction fails the same way.
+    rep_lo = _replay_artifact({"hard": {"p95_ms": 100.0}})
+    assert _run(tmp_path, rep_lo, live) == 1
+
+
+def test_regress_replay_overall_fallback_for_allhard_traces(tmp_path):
+    """Live artifacts without tier sections (no --mix) compare the
+    replay's overall p95 against the live resident p95."""
+    rep = _replay_artifact({"hard": {"p95_ms": 1900.0}})
+    live = _live_artifact(resident_p95=2000.0)  # no tiers
+    assert _run(tmp_path, rep, live) == 0
+    rep["overall"]["p95_ms"] = 9000.0
+    assert _run(tmp_path, rep, live) == 1
+
+
+def test_regress_replay_workload_mismatch_exits_2(tmp_path, capsys):
+    rep = _replay_artifact({"hard": {"p95_ms": 2000.0}},
+                           workload_params=dict(BENCH_PARAMS, seed=8))
+    live = _live_artifact(tiers={"hard": {"p95_ms": 2000.0}})
+    assert _run(tmp_path, rep, live) == 2
+    assert "DIFFERENT workload" in capsys.readouterr().err
+
+
+def test_regress_replay_mix_normalizes_spelling(tmp_path, capsys):
+    """'hard:6,easy:20' and 'easy:20,hard:6,repeat:0' are the SAME
+    workload; a genuinely different mix is exit 2."""
+    wl = dict(BENCH_PARAMS, mix="easy:20,hard:6,repeat:0")
+    lp = dict(BENCH_PARAMS, mix="hard:6,easy:20")
+    rep = _replay_artifact({"hard": {"p95_ms": 2000.0}}, workload_params=wl)
+    live = _live_artifact(tiers={"hard": {"p95_ms": 2000.0}}, params=lp)
+    assert _run(tmp_path, rep, live) == 0
+    live2 = _live_artifact(
+        tiers={"hard": {"p95_ms": 2000.0}},
+        params=dict(BENCH_PARAMS, mix="easy:10,hard:6"),
+    )
+    assert _run(tmp_path, rep, live2) == 2
+    assert "mix" in capsys.readouterr().err
+
+
+def test_regress_replay_scaling_knobs_exit_2(tmp_path, capsys):
+    live = _live_artifact(tiers={"hard": {"p95_ms": 2000.0}})
+    assert _run(
+        tmp_path,
+        _replay_artifact({"hard": {"p95_ms": 2000.0}}, rate_x=10.0),
+        live,
+    ) == 2
+    assert "rate_x" in capsys.readouterr().err
+    assert _run(
+        tmp_path,
+        _replay_artifact({"hard": {"p95_ms": 2000.0}}, nodes=3),
+        live,
+    ) == 2
+    assert "virtual nodes" in capsys.readouterr().err
+    # A reshaped node (--slots / --queue-depth off the recorded shape) is
+    # capacity exploration too (review finding): exit 2, never a
+    # MISPREDICTION.
+    reshaped = _replay_artifact({"hard": {"p95_ms": 2000.0}})
+    reshaped["params"]["recorded"] = {"job_slots": 8, "queue_depth": 64}
+    reshaped["params"]["slots"] = 2
+    assert _run(tmp_path, reshaped, live) == 2
+    assert "capacity exploration" in capsys.readouterr().err
+    reshaped["params"]["slots"] = 8
+    reshaped["params"]["queue_depth"] = 16
+    assert _run(tmp_path, reshaped, live) == 2
+    reshaped["params"]["queue_depth"] = 64
+    assert _run(tmp_path, reshaped, live) == 0
+
+
+def test_regress_zero_comparable_pairs_exits_2(tmp_path, capsys):
+    """A gate that compared NOTHING must not print OK (review finding):
+    a replay that shed every job (overall=None, empty tiers) against a
+    live artifact with no tier sections is exit 2, not a pass."""
+    rep = _replay_artifact({}, shed_total=48)
+    rep["overall"] = None
+    live = _live_artifact()  # no tiers section
+    assert _run(tmp_path, rep, live) == 2
+    assert "no comparable quantiles" in capsys.readouterr().err
+
+
+def test_regress_two_replays_exit_2(tmp_path, capsys):
+    rep = _replay_artifact({"hard": {"p95_ms": 2000.0}})
+    assert _run(tmp_path, rep, dict(rep)) == 2
+    assert "LIVE" in capsys.readouterr().err
+
+
+def test_regress_bench_vs_bench_unchanged(tmp_path):
+    """The pre-round-18 bench-vs-bench gate is untouched by the replay
+    rules (same schema, same exit codes)."""
+    a = _live_artifact()
+    b = _live_artifact()
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    assert regress.main([str(pa), str(pb)]) == 0
+
+
+# -- arrival-schedule determinism ----------------------------------------------
+
+
+def test_arrival_offsets_match_the_live_draw_order():
+    """poisson_load and the workload recorder must share ONE schedule:
+    offsets are the cumulative sums of the exact gap sequence the live
+    submit loop sleeps (same rng, same draw order)."""
+    import random
+
+    from benchmarks.bench_poisson import arrival_offsets, poisson_gaps
+
+    gaps = poisson_gaps(10, 0.05, seed=7)
+    rng = random.Random(7)
+    want = [rng.expovariate(1.0 / 0.05) for _ in range(9)]
+    assert gaps == want
+    offs = arrival_offsets(10, 0.05, seed=7)
+    assert offs[0] == 0.0 and len(offs) == 10
+    assert offs[3] == pytest.approx(sum(want[:3]))
+
+
+# -- slow lane: the recorded-trace round trip ----------------------------------
+
+
+@pytest.mark.slow
+def test_recorded_workload_replays_within_the_regress_band(
+    tmp_path, heavy_compile_guard
+):
+    """The acceptance round trip (ISSUE 15): record a live mixed-corpus
+    bench run as a workload trace, replay it, and the replay's per-tier
+    p95 must sit inside the regress.py noise band of the live artifact
+    that produced it (exit 0)."""
+    from benchmarks.bench_poisson import compare_poisson, parse_mix
+
+    out = compare_poisson(
+        n_jobs=0,
+        mean_gap_s=0.03,
+        handicap_s=0.0,
+        seed=11,
+        chunk_steps=8,
+        mix=parse_mix("easy:6,hard:1,repeat:3"),
+        record_workload=True,
+    )
+    workload = out.pop("workload")
+    assert workload["schema"] == WORKLOAD_SCHEMA
+    assert len(workload["jobs_trace"]) == 10
+    live = {
+        "schema": regress.SCHEMA,
+        "params": {
+            "jobs": out["jobs"], "mean_gap_ms": 30.0, "handicap_ms": 0.0,
+            "chunk_steps": 8, "seed": 11, "mix": "easy:6,hard:1,repeat:3",
+        },
+        "static": out["static"],
+        "resident": out["resident"],
+    }
+    # Workload params carry the identical identity (mix normalized).
+    assert regress._norm_mix(workload["params"]["mix"]) == regress._norm_mix(
+        live["params"]["mix"]
+    )
+    art = replay(
+        workload,
+        nodes=1,
+        seed=0,
+        # Headroom so the replayed control loop never sheds the recorded
+        # (healthy) run — any shed here would shrink the compared set.
+        slo_spec="solve_p95_ms<=60000,error_rate<=0.5",
+        bo_config=brownout.BrownoutConfig(quiet_s=5.0, hold_s=0.5),
+    )
+    assert art["completed"] == len(workload["jobs_trace"])
+    assert art["shed"]["total"] == 0
+    pr, pl = tmp_path / "replay.json", tmp_path / "live.json"
+    pr.write_text(json.dumps(art))
+    pl.write_text(json.dumps(live))
+    assert regress.main([str(pr), str(pl)]) == 0
