@@ -40,6 +40,20 @@ capacity experiment — with the brownout controller live — whose
 ``dsst-replay/1`` artifact ``benchmarks/regress.py`` can compare against
 a live ``--out-json`` run.
 
+``--latency-mode`` (round 19) adds a THIRD measured pass over the same
+arrival schedule: an engine built with ``latency_mode=True`` plus a
+megastep config, so every hard board rides the serving megastep
+(``serving/megastep.py``) — N advance chunks fused into ONE donated
+dispatch with in-graph early exit, ONE host status sync per *flight*
+instead of one per chunk.  Under ``--handicap-ms F`` the chunked paths
+pay F per chunk while the megastep pays F once per job, which is
+exactly the interactive win the round-5 numbers said was left
+(``rpc_floor_ms`` ~99% of hard-board p50).  The pass lands as a
+``megastep`` section in ``--out-json`` (same quantile shape as
+static/resident, so ``benchmarks/regress.py`` gates it whenever both
+artifacts carry it) plus the per-route ``frontdoor_megastep_ms``
+histogram and the flight counters (flights, chunks/flight, degrades).
+
 ``--mix easy:N,hard:M,repeat:R`` (round 17) swaps the all-hard corpus
 for a realistic mixed-difficulty stream — distinct easy and hard boards
 plus *symmetry-transformed* repeats of already-sent ones — and runs both
@@ -97,12 +111,19 @@ def arrival_offsets(n_boards: int, mean_gap_s: float, seed: int = 0) -> list:
 
 
 def poisson_load(engine, boards, mean_gap_s: float, seed: int = 0,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, latency: bool = False):
     """Submit ``boards`` with exponential inter-arrival gaps; returns
     ``(latencies_s, jobs)`` where latency is submit -> resolution wall
-    (inf for a job that missed ``timeout``)."""
+    (inf for a job that missed ``timeout``).
+
+    ``latency=True`` submits each arrival with the per-request
+    ``latency`` flag from its OWN thread: a megastep-routed submit
+    resolves synchronously inside ``submit()`` (the flight IS the
+    request), so an inline submit would stall the Poisson clock behind
+    the flight wall.  The arrival schedule is identical either way —
+    the pacing thread still sleeps the same seeded gaps."""
     gaps = poisson_gaps(len(boards), mean_gap_s, seed)
-    jobs: list = []
+    jobs: list = [None] * len(boards)
     lats = [float("inf")] * len(boards)
     threads = []
 
@@ -110,10 +131,20 @@ def poisson_load(engine, boards, mean_gap_s: float, seed: int = 0,
         if job.wait(timeout):
             lats[i] = time.monotonic() - job.submitted_at
 
+    def fire(i, board):
+        t0 = time.monotonic()
+        job = engine.submit(np.asarray(board, np.int32), latency=True)
+        jobs[i] = job
+        if job.wait(timeout):
+            lats[i] = time.monotonic() - t0
+
     for i, board in enumerate(boards):
-        job = engine.submit(np.asarray(board, np.int32))
-        jobs.append(job)
-        t = threading.Thread(target=waiter, args=(i, job), daemon=True)
+        if latency:
+            t = threading.Thread(target=fire, args=(i, board), daemon=True)
+        else:
+            job = engine.submit(np.asarray(board, np.int32))
+            jobs[i] = job
+            t = threading.Thread(target=waiter, args=(i, job), daemon=True)
         t.start()
         threads.append(t)
         if i + 1 < len(boards):
@@ -228,6 +259,7 @@ def compare_poisson(
     chunk_steps: int = 8,
     mix: Optional[dict] = None,
     record_workload: bool = False,
+    latency_mode: bool = False,
 ) -> dict:
     """One A/B: identical arrival schedule against a static-flight engine
     and a resident-flight engine (same solver config, same chunk
@@ -240,6 +272,13 @@ def compare_poisson(
     percentiles land beside the overall numbers: cache/native routes
     never pay the handicapped device fetch seam, so no dispatch floor
     applies to them.
+
+    ``latency_mode=True`` adds a third pass over the same schedule: an
+    engine with the serving megastep installed (``latency_mode=True``
+    plus a default ``MegastepConfig``), each arrival submitted with the
+    per-request ``latency`` flag so hard boards fly one-sync-per-flight.
+    Its quantiles land in ``out['megastep']`` beside the flight
+    counters and the ``frontdoor_megastep_ms`` histogram.
 
     ``record_workload=True`` captures the RESIDENT run (the production
     engine shape) as a versioned workload trace (``dsst-workload/1``,
@@ -391,10 +430,57 @@ def compare_poisson(
     finally:
         resident.stop(timeout=2)
 
+    if latency_mode:
+        from distributed_sudoku_solver_tpu.serving.megastep import (
+            MegastepConfig,
+        )
+
+        mega = SolverEngine(
+            config=cfg,
+            max_batch=8,
+            handicap_s=handicap_s,
+            chunk_steps=chunk_steps,
+            latency_mode=True,
+            megastep=MegastepConfig(),
+            frontdoor=_make_frontdoor(),
+        ).start()
+        try:
+            # Warm the megastep jit (attach/advance/verdict) the same way
+            # the other sides warm theirs — off the front door.
+            w = mega.submit(boards[0], frontdoor=False, latency=True)
+            assert w.wait(300)
+            lats, jobs = poisson_load(
+                mega, boards, mean_gap_s, seed, latency=True
+            )
+            assert all(
+                j is not None and j.solved for j in jobs
+            ), "megastep engine failed a job"
+            out["megastep"] = _percentiles(lats)
+            _route_tier_sections(out["megastep"], lats, jobs)
+            mm = mega.metrics()
+            out["megastep_metrics"] = mm.get("megastep", {}).get("9x9", {})
+            out["megastep_metrics"]["unfit"] = mm.get("megastep_unfit", 0)
+            # The per-route histogram: ONE sample per flight — the whole
+            # point.  Its count vs the chunked sides' chunk.sync counts
+            # is the measured sync-elimination.
+            out["megastep_hist"] = {
+                "frontdoor_megastep_ms": (mm.get("hist") or {}).get(
+                    "frontdoor_megastep_ms"
+                )
+            }
+        finally:
+            mega.stop(timeout=2)
+
     for q in ("p50_ms", "p95_ms", "p99_ms"):
         if out["resident"][q] > 0:
             out[f"speedup_{q[:-3]}"] = round(
                 out["static"][q] / out["resident"][q], 2
+            )
+        if latency_mode and out["megastep"][q] > 0:
+            # vs the STATIC side: the chunked baseline the ISSUE's
+            # "kill the dispatch floor" claim is measured against.
+            out[f"megastep_speedup_{q[:-3]}"] = round(
+                out["static"][q] / out["megastep"][q], 2
             )
     return out
 
@@ -418,6 +504,15 @@ def main() -> None:
         "per-route/per-tier percentiles are reported.  --jobs is ignored "
         "(the mix counts size the corpus).  Artifacts with different "
         "mixes are non-comparable in benchmarks/regress.py (exit 2)",
+    )
+    ap.add_argument(
+        "--latency-mode",
+        action="store_true",
+        help="also measure a third engine with the serving megastep "
+        "(serving/megastep.py): one donated dispatch, in-graph early "
+        "exit, ONE host sync per flight — adds a 'megastep' section to "
+        "the report/artifact which benchmarks/regress.py gates whenever "
+        "both artifacts carry it",
     )
     ap.add_argument("--json", action="store_true")
     ap.add_argument(
@@ -472,6 +567,7 @@ def main() -> None:
             chunk_steps=args.chunk_steps,
             mix=parse_mix(args.mix) if args.mix else None,
             record_workload=bool(args.workload_out),
+            latency_mode=args.latency_mode,
         )
     finally:
         compilewatch_mod.install(None)
@@ -550,6 +646,27 @@ def main() -> None:
             "rpc_floor_ms": out.get("rpc_floor_ms"),
             "hist": out.get("hist"),
             "compile": out.get("compile"),
+            # Latency-mode tier (round 19): same quantile shape as
+            # static/resident, so regress.py gates it whenever BOTH
+            # artifacts carry it; params stay unchanged because the
+            # megastep pass is ADDITIVE — the static/resident sections
+            # still measured the identical workload and remain
+            # comparable to pre-round-19 artifacts.
+            **(
+                {
+                    "megastep": out["megastep"],
+                    "megastep_detail": {
+                        "metrics": out.get("megastep_metrics"),
+                        "hist": out.get("megastep_hist"),
+                        "speedups_vs_static": {
+                            q: out.get(f"megastep_speedup_{q}")
+                            for q in ("p50", "p95", "p99")
+                        },
+                    },
+                }
+                if args.latency_mode
+                else {}
+            ),
         }
         tmp = args.out_json + ".tmp"
         with open(tmp, "w") as f:
@@ -565,8 +682,10 @@ def main() -> None:
         f"{out['handicap_ms']:.0f} ms"
     )
     print(f"{'':<10}{'p50 ms':>10}{'p95 ms':>10}{'p99 ms':>10}{'mean ms':>10}")
-    for name in ("static", "resident"):
-        r = out[name]
+    for name in ("static", "resident", "megastep"):
+        r = out.get(name)
+        if r is None:
+            continue
         print(
             f"{name:<10}{r['p50_ms']:>10}{r['p95_ms']:>10}"
             f"{r['p99_ms']:>10}{r['mean_ms']:>10}"
@@ -578,6 +697,22 @@ def main() -> None:
             sp99=out.get("speedup_p99"),
         )
     )
+    if "megastep" in out:
+        print(
+            "megastep   p50 x{sp50}  p95 x{sp95}  p99 x{sp99}  (vs static)"
+            .format(
+                sp50=out.get("megastep_speedup_p50"),
+                sp95=out.get("megastep_speedup_p95"),
+                sp99=out.get("megastep_speedup_p99"),
+            )
+        )
+        msm = out.get("megastep_metrics", {})
+        print(
+            f"  flights={msm.get('flights')} "
+            f"chunks/flight={msm.get('chunks_per_flight')} "
+            f"degraded={msm.get('degraded')} "
+            f"flight_wall_ms={ (msm.get('flight_wall_ms') or {}).get('p50') }"
+        )
     if "mix" in out:
         print(f"mix: {out['mix']}  (resident engine breakdown)")
         for section in ("tiers", "routes"):

@@ -23,6 +23,13 @@ obs/compilewatch accounting): a cold-cache side is *labeled* — its
 quantiles include compile noise, and a cold-vs-warm compare earns an
 explicit "re-run warm" note instead of hiding inside the band.
 
+Latency-mode artifacts (``bench_poisson --latency-mode``, round 19)
+carry an additional ``megastep`` section with the same quantile shape
+as static/resident; the gate includes it whenever BOTH artifacts carry
+it.  The section is additive — params are unchanged, so a latency-mode
+artifact still compares against a pre-round-19 artifact on the
+static/resident sides (with a note that the new tier went ungated).
+
 Mixed-corpus artifacts (``bench_poisson --mix``, round 17) are only
 comparable to artifacts with the *identical* mix: the overall quantiles
 blend cache/native/device routes in mix-specific proportions, so a
@@ -155,7 +162,25 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
             "warm new vs cold old: an apparent improvement may be the "
             "cache warming, not the code — re-run the baseline warm"
         )
-    for side in SIDES:
+    # The latency-mode tier (bench_poisson --latency-mode): gated only
+    # when both artifacts measured it — a one-sided megastep section is
+    # a flag difference, not a workload difference (params are equal or
+    # we'd have exited 2 above), so note it instead of failing.
+    sides: List[str] = list(SIDES)
+    has_mega = {
+        label: isinstance(doc.get("megastep"), dict)
+        for label, doc in (("old", old), ("new", new))
+    }
+    if all(has_mega.values()):
+        sides.append("megastep")
+    elif any(has_mega.values()):
+        only = "old" if has_mega["old"] else "new"
+        notes.append(
+            f"only the {only} artifact carries the megastep "
+            "(latency-mode) tier — that tier is NOT gated; run both "
+            "sides with --latency-mode to gate it"
+        )
+    for side in sides:
         for q in QUANTS:
             o = float(old[side][q])
             n = float(new[side][q])
@@ -366,9 +391,15 @@ def main(argv: Union[List[str], None] = None) -> int:
             "of the live run (per-tier p95)"
         )
     else:
+        gated = list(SIDES)
+        if all(
+            isinstance(d, dict) and isinstance(d.get("megastep"), dict)
+            for d in (old, new)
+        ):
+            gated.append("megastep")
         print(
             f"regress: OK — no regression beyond {args.tol * 100:.0f}% "
-            f"({', '.join(f'{s} {q}' for s in SIDES for q in QUANTS)})"
+            f"({', '.join(f'{s} {q}' for s in gated for q in QUANTS)})"
         )
     return 0
 

@@ -211,11 +211,16 @@ SIMNET_RUNTIME_BANNED = (
 # one status fetch per dispatch) and the portfolio racer's poll/drain
 # (``serving/portfolio.py`` — the cover race's between-dispatch liveness
 # poll is that loop's one deliberate sync).
+# Round 19 extends it again to the latency-mode serving megastep
+# (``serving/megastep.py``): its whole contract is ONE host sync per
+# flight (attach through verdict), so the flight body is a hot region —
+# any stray sync there silently doubles the tier's latency floor.
 SYNC_SCOPED_FILES = (
     "serving/engine.py",
     "serving/scheduler.py",
     "ops/bulk.py",
     "serving/portfolio.py",
+    "serving/megastep.py",
 )
 
 SYNC_HOT_REGIONS = {
@@ -239,6 +244,13 @@ SYNC_HOT_REGIONS = {
     "serving/portfolio.py": (
         "race_jobs",
         "race_cover.device_entrant",
+    ),
+    # The megastep's one-sync-per-flight contract, PROVEN: everything
+    # from admission to verdict runs inside these two bodies, so syncck
+    # sees every host-transfer call the flight could ever make.
+    "serving/megastep.py": (
+        "MegastepFlight.solve",
+        "MegastepFlight._fly",
     ),
 }
 
@@ -445,6 +457,26 @@ ENTRY_POINTS = (
         static={"geom": "geom", "config": "config"},
         donate=(0,), donation="threads", hot=True,
     ),
+    # ops/frontier.py / ops/pallas_step.py — the latency-mode serving
+    # megastep (round 19): N advance chunks fused into ONE donated
+    # dispatch via an in-graph while_loop with early exit on
+    # all-solved/all-dead.  Both scalars are TRACED (chunk_steps,
+    # max_chunks) so retuning the flight budget never recompiles — the
+    # compile watch alarms if it ever does.
+    dict(
+        name="ops.frontier.advance_megastep", display="advance_megastep",
+        fn="distributed_sudoku_solver_tpu.ops.frontier:advance_megastep",
+        args=(("frontier", "config"), ("array", (), "int32"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="ops.pallas_step.advance_megastep_fused", display="advance_megastep_fused",
+        fn="distributed_sudoku_solver_tpu.ops.pallas_step:advance_megastep_fused",
+        args=(("frontier", "config_fused"), ("array", (), "int32"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config_fused"},
+        donate=(0,), donation="threads", hot=True,
+    ),
     # ops/pallas_step.py — the fused twins (abstract tracing never
     # compiles Mosaic, so these prove out on any backend)
     dict(
@@ -501,6 +533,19 @@ DISPLAY_BY_NAME = {e["name"]: entry_display(e) for e in ENTRY_POINTS}
 # compiled program).  ``debug.print`` lowers to debug_callback.
 JAXCK_BANNED_CALLBACKS = ("pure_callback", "io_callback", "debug_callback")
 
+# Hot entry points granted a DOCUMENTED callback carve-out: entry name ->
+# one-line reason.  This table is the design decision the megastep issue
+# (round 16) demanded be explicit rather than waived inline: IF a
+# device-resident mailbox ever needs a host callback to close its loop,
+# the entry is listed here with its why, jaxck notes the allowance in its
+# summary, and the callback stays drift-visible in the golden.  It is
+# DELIBERATELY EMPTY today — the megastep's mailbox is pure-device (the
+# packed status word + early-exit chunk count ride the one per-flight
+# fetch), so its programs stay callback-free like every other hot
+# program.  An entry added here is a reviewed contract change, not a
+# local waiver.
+JAXCK_CALLBACK_CARVEOUTS: dict = {}
+
 # -- deadck --------------------------------------------------------------
 #
 # The thread-plane manifest: every lock in the repo, named and ranked.
@@ -549,6 +594,14 @@ LOCK_RANKS = {
     "serving.brownout": 28,   # serving/brownout.py BrownoutController._lock
     "serving.engine": 30,     # serving/engine.py SolverEngine._lock
     "serving.scheduler": 34,  # serving/scheduler.py ResidentFlight._lock
+    # Between the scheduler and the breaker: the megastep flight
+    # (serving/megastep.py, round 19) is created under engine._lock
+    # (30 < 36 legal) and consults its own circuit breaker under its
+    # flight lock (36 < 38 legal) — the same nesting shape as the
+    # resident flight one rank below.  solve() RELEASES the flight lock
+    # before engine._finish_job so no obs/slo acquisition ever nests
+    # under it.
+    "serving.megastep": 36,   # serving/megastep.py MegastepFlight._lock
     "serving.breaker": 38,    # serving/faults.py CircuitBreaker._lock
     "serving.injector": 40,   # serving/faults.py FaultInjector._lock
     "serving.control": 42,    # serving/engine.py _Control.lock (dataclass field)
@@ -610,6 +663,21 @@ LOCK_EDGE_DECLARED = {
         "injected virtual clock: SloMonitor(clock=net.now) reads the "
         "SimNet condition inside its locked prune/observe paths"
     ),
+    # Compile-under-lock (round 19): the megastep's FIRST flight
+    # jit-compiles attach/advance/verdict inside the flight lock, and an
+    # installed CompileWatch's jax monitoring callback records the
+    # compile wall into a LatencyHistogram synchronously on the
+    # compiling thread — so the flight lock transiently precedes
+    # obs.hist.  Rank-upward (serving.megastep 36 < obs.hist 66) and
+    # invisible to statics: the callback is registered with jax's
+    # monitoring hook, not called from megastep source.  The direct
+    # serving.megastep -> obs.compilewatch hop is already static (the
+    # cost-plane capture_cost seam).
+    ("serving.megastep", "obs.hist"): (
+        "jax monitoring callback under the flight lock: the first "
+        "flight's compile fires CompileWatch.on_duration -> "
+        "LatencyHistogram.record on the compiling thread"
+    ),
 }
 LOCK_EDGE_DECLARED.update({
     ("obs.slo", target): _SLO_DUMP_REASON
@@ -619,6 +687,10 @@ LOCK_EDGE_DECLARED.update({
         "serving.brownout",
         "serving.engine",
         "serving.scheduler",
+        # engine.metrics reads the megastep flight counters (round 19) —
+        # same injected-callable closure, same rank-upward legality
+        # (obs.slo 24 < serving.megastep 36).
+        "serving.megastep",
         "serving.breaker",
         "serving.injector",
         # engine.metrics also reads the front-door counters/cache
@@ -649,6 +721,7 @@ DEADCK_BASE_CLASSES = {
     "ex": ("cluster/node.py", "_Exec"),
     "rf": ("serving/scheduler.py", "ResidentFlight"),
     "flight": ("serving/scheduler.py", "ResidentFlight"),
+    "mf": ("serving/megastep.py", "MegastepFlight"),
     "self.breaker": ("serving/faults.py", "CircuitBreaker"),
     "req": ("serving/engine.py", "_Control"),
     "self._dedupe": ("cluster/node.py", "_DedupeLRU"),
@@ -701,6 +774,13 @@ DEADCK_THREAD_ROOTS = {
         "race",                   # racer entrant threads (device/native)
         "race_cover",
         "race_jobs",
+    ),
+    "serving/megastep.py": (
+        # The megastep flight resolves jobs synchronously on whichever
+        # client/handler thread submitted them — every counter write in
+        # the flight is reachable from concurrent submit threads, so
+        # guard inference must prove them all.
+        "MegastepFlight.solve",
     ),
     "serving/brownout.py": (
         # The controller is reached from HTTP handler threads (the front

@@ -88,6 +88,23 @@ def build_parser() -> argparse.ArgumentParser:
         "429 + Retry-After",
     )
     ap.add_argument(
+        "--latency-mode",
+        action="store_true",
+        help="serve every eligible /solve through the megastep tier "
+        "(serving/megastep.py): the whole advance loop fuses into one "
+        "donated device dispatch with in-graph early exit — one host "
+        "sync per request instead of one per chunk.  Per-request opt-in "
+        "stays available via POST /solve?latency=1 without this flag",
+    )
+    ap.add_argument(
+        "--megastep-chunks",
+        type=int,
+        default=64,
+        help="megastep in-graph loop bound (flight step budget = "
+        "chunk-steps x this); a board still holding work past it "
+        "degrades to the chunked resident path",
+    )
+    ap.add_argument(
         "--no-frontdoor",
         action="store_true",
         help="bypass the front door (serving/frontdoor): no symmetry-"
@@ -343,6 +360,18 @@ def make_engine(args) -> SolverEngine:
             cache_entries=args.cache_entries,
             easy_score=args.easy_score,
         )
+    megastep = None
+    if solve_fn is None:
+        # The megastep tier needs the flight loop's jitted seams, so the
+        # sharded solve_fn override (legacy one-dispatch path) excludes
+        # it.  The config exists even when latency_mode is off: the
+        # per-request /solve?latency=1 opt-in still routes here.
+        from distributed_sudoku_solver_tpu.serving.megastep import MegastepConfig
+
+        megastep = MegastepConfig(
+            gang_lanes=args.resident_gang,
+            max_chunks=args.megastep_chunks,
+        )
     return SolverEngine(
         config=cfg,
         max_batch=args.max_batch,
@@ -356,6 +385,8 @@ def make_engine(args) -> SolverEngine:
             breaker_cooldown_s=args.breaker_cooldown,
         ),
         frontdoor=frontdoor,
+        latency_mode=args.latency_mode,
+        megastep=megastep,
     )
 
 

@@ -1116,6 +1116,7 @@ class ClusterNode:
         base_nodes: int = 0,
         config=None,
         saturation: str = "fallback",
+        latency: Optional[bool] = None,
     ) -> _Exec:
         """Run a job (or subtree part) on the local engine under an _Exec
         aggregate; ``on_final`` fires exactly once with the merged result.
@@ -1133,7 +1134,8 @@ class ClusterNode:
             )
         else:
             ej = self.engine.submit(
-                grid, job_uuid=job_uuid, config=config, saturation=saturation
+                grid, job_uuid=job_uuid, config=config, saturation=saturation,
+                latency=latency,
             )
 
         def wrapped(result: dict) -> None:
@@ -1173,9 +1175,14 @@ class ClusterNode:
             self.engine.cancel(p)
 
     # -- job dispatch --------------------------------------------------------
-    def submit(self, grid, config=None) -> Job:
+    def submit(self, grid, config=None, latency=None) -> Job:
         """Dispatch one job to the least-loaded member; ``config`` optionally
-        overrides the solver strategy for this job (rides the TASK)."""
+        overrides the solver strategy for this job (rides the TASK).
+
+        ``latency`` opts a LOCAL dispatch into the engine's megastep tier
+        (serving/megastep.py).  The flag deliberately does not ride the
+        wire: latency-mode is a node-local serving decision — a member
+        serves remote TASKs by its own engine's ``latency_mode`` default."""
         g = np.asarray(grid, dtype=np.int32)
         if g.ndim != 2 or g.shape[0] != g.shape[1]:
             raise ValueError(f"grid must be square, got {g.shape}")
@@ -1186,7 +1193,9 @@ class ClusterNode:
             # of quietly growing an unbounded queue.  Remote dispatch has
             # no cross-wire backpressure: the TASK lands in the member's
             # static path if its resident flight is full.
-            return self._submit_local(g, config=config, saturation="reject")
+            return self._submit_local(
+                g, config=config, saturation="reject", latency=latency
+            )
         return self._submit_remote(g, member, config=config)
 
     def race(self, grid, configs, timeout: Optional[float] = None):
@@ -1250,7 +1259,8 @@ class ClusterNode:
             self._outstanding[member] = self._outstanding.get(member, 0) + delta
 
     def _submit_local(
-        self, g: np.ndarray, config=None, saturation: str = "fallback"
+        self, g: np.ndarray, config=None, saturation: str = "fallback",
+        latency=None,
     ) -> Job:
         geom = geometry_for_size(g.shape[0])
         ju = str(uuid_mod.uuid4())
@@ -1263,7 +1273,8 @@ class ClusterNode:
 
         try:
             self._start_exec(
-                fin, grid=g, job_uuid=ju, config=config, saturation=saturation
+                fin, grid=g, job_uuid=ju, config=config, saturation=saturation,
+                latency=latency,
             )
         except BaseException:
             # submit can raise (e.g. "engine stopped"); un-count or the +1

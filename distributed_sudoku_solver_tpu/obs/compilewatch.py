@@ -95,6 +95,10 @@ CACHE_EVENTS = {
 #: name their program through these, so the strings live in one place.
 ADVANCE_STATUS = "utils.checkpoint.advance_frontier_status"
 ADVANCE_FUSED_STATUS = "ops.pallas_step.advance_frontier_fused_status"
+# The latency-mode megastep programs (serving/megastep.py): one whole
+# flight per dispatch, so a recompile here is a whole-tier latency cliff.
+ADVANCE_MEGASTEP = "ops.frontier.advance_megastep"
+ADVANCE_MEGASTEP_FUSED = "ops.pallas_step.advance_megastep_fused"
 
 #: The attribution bucket for compilations no registered program grew for.
 UNREGISTERED = "unregistered"

@@ -955,6 +955,67 @@ def advance_frontier_fused_status(state, steps_delta: jax.Array, geom: Geometry,
     return new, chunk_status(state.steps, state.lane_rounds, new)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("geom", "config"), donate_argnums=(0,)
+)
+def advance_megastep_fused(
+    state, chunk_steps: jax.Array, max_chunks: jax.Array, geom: Geometry, config
+):
+    """Fused twin of ``ops.frontier.advance_megastep``: the latency-mode
+    in-graph chunk loop over whole-round VMEM kernel dispatches.
+
+    One donated dispatch runs up to ``max_chunks`` fused chunks inside an
+    outer ``lax.while_loop`` and early-exits on all-solved/all-dead, so the
+    latency-mode serving path (``serving/megastep.py``) syncs once per
+    FLIGHT.  The state stays boards-last across every inner chunk — the
+    lane-first transposes happen once per flight, not once per chunk — and
+    the packed status word is recomputed per inner chunk directly on the
+    ``FusedFrontier`` (``chunk_status`` only touches fields the two frontier
+    forms share: has_top / job / solved / lane_rounds / steps).
+
+    Returns ``(new_state, status, chunks)`` with the same flight-start
+    status baselines and early-exit round count as the composite twin;
+    ``steps`` may overshoot each inner chunk's limit by up to
+    ``fused_steps - 1`` rounds exactly like :func:`advance_frontier_fused`.
+    """
+    from distributed_sudoku_solver_tpu.ops.frontier import (
+        FUSED_STEPS_DEVICE,
+        STATUS_BITS,
+        chunk_status,
+    )
+
+    config = config.with_fused_steps(FUSED_STEPS_DEVICE)
+    n_jobs = state.solved.shape[0]
+    w = (n_jobs + 31) // 32
+    fs0 = frontier_to_fused(state)
+    steps0 = fs0.steps
+    rounds0 = fs0.lane_rounds
+    chunk = jnp.int32(chunk_steps)
+    budget = jnp.int32(config.max_steps)
+
+    def one_chunk(fs: FusedFrontier):
+        new = _run_fused(
+            fs, geom, config, jnp.minimum(fs.steps + chunk, budget)
+        )
+        return new, chunk_status(steps0, rounds0, new)
+
+    def cond(carry):
+        fs, status, chunks = carry
+        alive = jnp.any(status[STATUS_BITS + w : STATUS_BITS + 2 * w] != 0)
+        return alive & (chunks < jnp.int32(max_chunks)) & (fs.steps < budget)
+
+    def body(carry):
+        fs, _, chunks = carry
+        new, status = one_chunk(fs)
+        return new, status, chunks + jnp.int32(1)
+
+    fs, status = one_chunk(fs0)
+    fs, status, chunks = jax.lax.while_loop(
+        cond, body, (fs, status, jnp.int32(1))
+    )
+    return fused_to_frontier(fs), status, chunks
+
+
 @functools.partial(jax.jit, static_argnames=("geom", "config"))
 def solve_batch_fused(
     grids: jax.Array, geom: Geometry, config

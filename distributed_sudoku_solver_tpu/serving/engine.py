@@ -246,6 +246,8 @@ class SolverEngine:
         recovery: Optional[faults.RecoveryPolicy] = None,
         clock: Callable[[], float] = time.monotonic,
         frontdoor=None,  # Optional[serving.frontdoor.FrontDoorConfig]
+        latency_mode: bool = False,
+        megastep=None,  # Optional[serving.megastep.MegastepConfig]
     ):
         self.config = config
         self.max_batch = max_batch
@@ -320,6 +322,13 @@ class SolverEngine:
                 "frontdoor_propagation_ms",
                 "frontdoor_native_ms",
                 "frontdoor_device_ms",
+                # Latency-mode megastep flights (serving/megastep.py):
+                # whole-flight walls — attach through the ONE status
+                # sync.  Deliberately NOT recorded into the per-chunk
+                # chunk_wall_ms / sync_wall_ms seams: one megastep sync
+                # covers N in-graph chunks, so a per-chunk histogram
+                # would double-count it N-fold (round-16 sweep).
+                "frontdoor_megastep_ms",
             )
         }
         # Live RPC-floor estimate from the chunk.sync samples (both serving
@@ -348,16 +357,30 @@ class SolverEngine:
         self._resident: dict = {}  # Geometry -> ResidentFlight
         self.resident_unfit = 0  # lockck: guard(_lock) — geometries the resident fused shape
         #   cannot serve (fell back to static flights at submit time)
+        # Latency-mode serving megastep (serving/megastep.py, ISSUE 16):
+        # single hard boards fuse their whole advance loop into ONE
+        # donated dispatch with in-graph early exit — one host sync per
+        # flight instead of one per chunk.  Opt-in per engine
+        # (latency_mode=True) or per submit (latency=True); a failed or
+        # budget-exhausted megastep degrades to the chunked paths below.
+        # The dict is guarded by _lock; flights own their rank-36 lock.
+        self.latency_mode = bool(latency_mode)
+        self.megastep_config = megastep
+        self._megasteps: dict = {}  # Geometry -> MegastepFlight | None
+        self.megastep_unfit = 0  # lockck: guard(_lock) — geometries the megastep
+        #   gang shape cannot serve (degraded to chunked paths at submit time)
         # Insertion-ordered so stale entries (cancels for jobs that already
         # finished or never arrive) can be pruned oldest-first.
         self._cancelled: "dict[str, None]" = {}
         self._lock = lockdep.named_lock("serving.engine")  # lockck: name(serving.engine)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # Counters (single-writer: the device loop; readers tolerate staleness).
-        self.validations = 0
-        self.solved_count = 0
-        self.jobs_done = 0
+        # Job-outcome counters (readers tolerate staleness).  Guarded
+        # since round 19: megastep flights resolve jobs on submit
+        # threads, so the device loop is no longer the single writer.
+        self.validations = 0  # lockck: guard(_lock)
+        self.solved_count = 0  # lockck: guard(_lock)
+        self.jobs_done = 0  # lockck: guard(_lock)
         # Fused flights downgraded to the composite step at launch because
         # the config's (geometry, stack depth, lane width) sits outside the
         # kernel's measured compile boundary (see _fit_fused).
@@ -436,6 +459,7 @@ class SolverEngine:
         saturation: str = "fallback",
         frontdoor: bool = True,
         shadow: bool = False,
+        latency: Optional[bool] = None,
     ) -> Job:
         """Enqueue one job.  With a front door installed
         (``SolverEngine(frontdoor=...)``), eligible jobs cross it first:
@@ -453,7 +477,15 @@ class SolverEngine:
         static flight path.  ``saturation`` picks the policy when the
         resident admission queue is full: ``'fallback'`` (default) quietly
         uses a static flight, ``'reject'`` raises ``EngineSaturated`` — the
-        HTTP layer's 429 + Retry-After backpressure."""
+        HTTP layer's 429 + Retry-After backpressure.
+
+        ``latency`` opts this submit into the serving megastep
+        (serving/megastep.py): the whole advance loop fuses into ONE
+        donated dispatch with in-graph early exit, resolving the job on
+        the caller's thread with a single host sync.  ``None`` defers to
+        the engine-wide ``latency_mode`` flag; a megastep that cannot
+        serve the board (unfit geometry, budget exhausted, device fault)
+        quietly degrades to the chunked resident/static paths below."""
         g = np.asarray(grid, dtype=np.int32)  # syncck: allow(client input coercion at submit time — list/ndarray host data, not the hot loop)
         geom = geom or geometry_for_size(g.shape[0])
         if g.shape != (geom.n, geom.n):
@@ -493,6 +525,16 @@ class SolverEngine:
             if owned:
                 return job
             fd_routed = True
+        if self._megastep_eligible(job, latency):
+            # Commit the front-door routing decision BEFORE the flight:
+            # the megastep resolves synchronously on this thread, and the
+            # cache-fill hook (frontdoor.commit_device installs
+            # job.on_resolve) must be registered when _finish_job fires.
+            if fd_routed:
+                self.frontdoor.commit_device(job, fd_token)
+                fd_routed = False
+            if self._route_megastep(job):
+                return job
         if not self._route_resident(job, saturation):
             self._enqueue(job)
         if fd_routed:
@@ -526,6 +568,57 @@ class SolverEngine:
         # flight permanently closed — a broken resident program must not
         # read as client backpressure): serve on a static flight.
         return False
+
+    def _megastep_eligible(self, job: Job, latency: Optional[bool]) -> bool:
+        """Whether this submit may take the latency-mode megastep: the
+        caller (or the engine default) asked for it, and the job is a
+        plain single-board solve — per-job configs, roots resumes and
+        enumeration keep the chunked paths, same gate as the resident."""
+        want = self.latency_mode if latency is None else bool(latency)
+        return (
+            want
+            and self._use_flights
+            and job.config is None
+            and job.roots is None
+            and not self.config.count_all
+        )
+
+    def _route_megastep(self, job: Job) -> bool:
+        """True if the megastep resolved the job (on THIS thread — the
+        flight is synchronous).  False degrades to the chunked paths:
+        unfit geometry, open breaker, in-graph budget exhausted, device
+        fault — all counted on the flight (round-9 taxonomy)."""
+        mf = self._megastep_for(job.geom)
+        if mf is None:
+            return False
+        # solve() runs outside the engine lock: it blocks on device work
+        # and acquires the flight's own rank-36 lock.
+        return mf.solve(job)
+
+    def _megastep_for(self, geom: Geometry):
+        """The geometry's megastep flight, created on first eligible
+        latency submit.  None = geometry unservable (gang shape misfit):
+        cached so the derivation isn't repaid per submit."""
+        with self._lock:
+            if self._stop.is_set():
+                return None
+            if geom in self._megasteps:
+                return self._megasteps[geom]
+            from distributed_sudoku_solver_tpu.serving.megastep import (
+                MegastepConfig,
+                MegastepFlight,
+            )
+
+            cfg = self.megastep_config or MegastepConfig()
+            try:
+                mf = MegastepFlight(self, geom, cfg)
+            except ValueError as e:
+                self.megastep_unfit += 1
+                self._megasteps[geom] = None  # don't re-derive per submit
+                _LOG.warning("[engine] megastep flight unfit for %s: %s", geom, e)
+                return None
+            self._megasteps[geom] = mf
+            return mf
 
     def _resident_for(self, geom: Geometry):
         """The geometry's resident flight, created on first eligible submit
@@ -685,6 +778,10 @@ class SolverEngine:
         with self._lock:
             return [rf for rf in self._resident.values() if rf is not None]
 
+    def _megastep_flights(self) -> list:
+        with self._lock:
+            return [mf for mf in self._megasteps.values() if mf is not None]
+
     def stats(self) -> dict:
         s = {
             "validations": int(self.validations),
@@ -757,6 +854,18 @@ class SolverEngine:
             }
         if self.resident_unfit:
             out["resident_unfit"] = int(self.resident_unfit)
+        megastep_flights = self._megastep_flights()
+        if megastep_flights:
+            # Latency-mode megastep observability (serving/megastep.py):
+            # flight/verdict counters, degrade taxonomy, chunk totals and
+            # whole-flight walls per geometry.  The matching
+            # frontdoor_megastep_ms histogram rides `hist` below.
+            out["megastep"] = {
+                f"{mf.geom.n}x{mf.geom.n}": mf.metrics()
+                for mf in megastep_flights
+            }
+        if self.megastep_unfit:
+            out["megastep_unfit"] = int(self.megastep_unfit)
         if self.frontdoor is not None:
             # The routing layer's own observability (serving/frontdoor):
             # cache hit/miss/eviction/canonical-dup counters, probe
@@ -826,6 +935,13 @@ class SolverEngine:
                 for rf in resident_flights:
                     rounds += rf.rounds_total
                     wall += rf.round_wall_total
+                for mf in megastep_flights:
+                    # Megastep flights advance rounds too (a latency-only
+                    # node must still light the gauge); their wall is the
+                    # whole-flight wall — the only wall the one-sync
+                    # design observes.
+                    rounds += mf.rounds_total
+                    wall += mf.round_wall_total
                 eff = cw.efficiency(
                     compilewatch.ADVANCE_FUSED_STATUS
                     if self.config.step_impl == "fused"
@@ -1590,10 +1706,16 @@ class SolverEngine:
             return
         wall = self._clock() - job.submitted_at
         self.latency.record(wall)
-        if job.solved:
-            self.solved_count += 1
-        self.validations += job.nodes
-        self.jobs_done += 1
+        # Guarded since round 19: the megastep flight resolves jobs on
+        # submit/handler threads, so these counters are no longer
+        # single-writer on the device loop.  _finish_job runs with no
+        # lock held (both callers' contract), so taking rank 30 here
+        # nests under nothing.
+        with self._lock:
+            if job.solved:
+                self.solved_count += 1
+            self.validations += job.nodes
+            self.jobs_done += 1
         rec = trace.active()
         # Histogram exemplar (the uuid linking a slow bucket to its
         # stitched trace) only when a recorder is installed — the
@@ -1807,9 +1929,10 @@ class SolverEngine:
                     cp.observe_job(job.uuid, wall)
             job.done.set()
         self.batch_sizes.record(float(len(group)))
-        self.validations += int(nodes[: len(group)].sum())
-        self.solved_count += int(solved[: len(group)].sum())
-        self.jobs_done += len(group)
+        with self._lock:  # shared with megastep-thread resolutions since round 19
+            self.validations += int(nodes[: len(group)].sum())
+            self.solved_count += int(solved[: len(group)].sum())
+            self.jobs_done += len(group)
 
 
 # -- jitted helpers (module-level so the cache is shared across engines) ------

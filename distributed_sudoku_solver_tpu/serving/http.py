@@ -44,6 +44,20 @@ Superset endpoints (absent from the reference):
   per-node breakdown plus a merged rollup (obs/agg.py) whose histogram
   counts are the vector sum of the members' — partitioned members are
   flagged ``unreachable``, never hung on.
+* ``POST /solve?latency=1`` — the interactive hard-tail route (round 19,
+  ``serving/megastep.py``): eligible boards (no per-job config, engine
+  not enumerating) are served as ONE donated device dispatch whose
+  in-graph ``lax.while_loop`` runs the whole chunk schedule with early
+  exit on solved/all-dead, so the handler thread syncs with the device
+  once per request instead of once per chunk — the round-trip floor
+  (``rpc_floor_ms``) is paid ~once, not ~N times.  The front door still
+  answers cache hits/easy boards first; a megastep that cannot serve the
+  board (unfit geometry, in-graph budget exhausted, device fault)
+  degrades silently to the chunked paths below.  Engines started with
+  ``--latency-mode`` serve every eligible ``/solve`` this way without
+  the query flag.  Per-route wall rides ``frontdoor_megastep_ms`` in the
+  ``hist`` section; the ``megastep`` metrics section carries flight /
+  verdict / degrade counters.
 * ``POST /solve`` with ``"count_all": true`` — enumerate EVERY solution
   to exhaustion and return the exact model count plus the first solution
   found (the reference's DFS stops at one solution and cannot express
@@ -164,12 +178,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     # Route table kept flat on purpose: few endpoints, like the reference.
     def do_POST(self):  # noqa: N802 (stdlib casing)
-        if self.path == "/solve_batch":
+        url = urlsplit(self.path)
+        if url.path == "/solve_batch":
             return self._solve_batch()
-        if self.path == "/profile":
+        if url.path == "/profile":
             return self._profile()
-        if self.path != "/solve":
+        if url.path != "/solve":
             return self._send(404, {"error": "not found"})
+        # ``POST /solve?latency=1`` — the interactive hard-tail route
+        # (serving/megastep.py): the whole advance loop fuses into ONE
+        # donated device dispatch with in-graph early exit, resolving on
+        # this handler thread with a single host sync.  Opt-in per
+        # request; an engine started with ``latency_mode`` serves every
+        # eligible /solve this way without the flag.
+        latency = parse_qs(url.query).get("latency", ["0"])[0] not in (
+            "", "0", "false",
+        )
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length))
@@ -222,7 +246,7 @@ class _Handler(BaseHTTPRequestHandler):
             strategy = res.strategy
         else:
             try:
-                job = node.submit(grid)
+                job = node.submit(grid, latency=True) if latency else node.submit(grid)
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
             except BrownoutShed as e:
@@ -814,7 +838,7 @@ class StandaloneNode:
         self.engine = engine
         self.address = address
 
-    def submit(self, grid):
+    def submit(self, grid, latency=None):
         import numpy as np
 
         g = np.asarray(grid, dtype=np.int32)
@@ -824,7 +848,7 @@ class StandaloneNode:
         # resident admission queue raises EngineSaturated here and the
         # HTTP layer answers 429 + Retry-After.  Library callers using the
         # engine directly keep the quiet static-flight fallback.
-        return self.engine.submit(g, saturation="reject")
+        return self.engine.submit(g, saturation="reject", latency=latency)
 
     def cancel(self, job_uuid: str) -> None:
         self.engine.cancel(job_uuid)

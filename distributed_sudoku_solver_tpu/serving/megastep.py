@@ -1,0 +1,381 @@
+"""Latency-mode serving megastep: one donated dispatch, one sync, per JOB.
+
+BENCH_r05 (TPU) pinned the interactive problem: a hard 9x9 board is
+~1 ms of device time but ~79 ms end-to-end, because the chunked serving
+loops pay the host round-trip floor once per CHUNK — ``rpc_floor_ms`` is
+~99% of the p50.  The front door (ISSUE 14) already answers repeats and
+easy boards without a device; the hard tail is the only slow tier left,
+and its cost is the dispatch loop itself, not the kernel.
+
+This module kills that floor for single hard boards.  A
+:class:`MegastepFlight` holds a small device-resident mailbox — a
+one-slot resident frontier (``serving/scheduler._init_resident``) whose
+slot is written by the scheduler's donated attach program — and serves a
+job as ONE in-graph flight:
+
+    attach (donated, async)
+      -> ``ops/frontier.advance_megastep`` (or the fused twin in
+         ``ops/pallas_step``): an in-graph ``lax.while_loop`` over
+         advance chunks that re-uses the round-8 packed status word per
+         inner chunk and EARLY-EXITS when the board solves or its
+         search space drains (all-dead), emitting the final status plus
+         the chunk count actually run
+      -> verdict program (async, non-donated)
+      -> ONE ``host_fetch`` for status + chunk count + verdict payload
+      -> detach (donated, async)
+
+The host therefore syncs once per *flight* instead of once per chunk:
+under a simulated 50 ms floor an N-chunk hard board pays ~1 floor, not
+~N.  The loop is pure device dataflow — NO host callbacks close the
+mailbox (the jaxck callback carve-out table in ``analysis/manifest.py``
+is deliberately empty; see ``JAXCK_CALLBACK_CARVEOUTS``).
+
+Degrade-to-chunked contract (round-9 taxonomy): a flight that exhausts
+``max_chunks`` with work left, overflows a lane stack, trips the fused
+shape validator, or dies in a device program does NOT error the job —
+``solve`` returns False and the engine falls through to the chunked
+resident/static paths, which own retries, shedding, and recovery.
+Sound because a degraded megastep never reports partial results: the
+slot is detached and the chunked path re-solves from the clue grid.
+Failures feed the flight's circuit breaker (``serving/faults``), so a
+broken device program deflects future latency-mode submits in O(1).
+
+Accounting contract (the round-19 double-count sweep): the megastep's
+single sync is recorded in ``frontdoor_megastep_ms`` (whole-flight wall)
+and NOWHERE else — it must not land in the per-chunk ``chunk_wall_ms``/
+``sync_wall_ms`` seams, whose samples mean "one chunk's sync", nor in
+the ``rpc_floor`` estimator, whose samples mean "one floor".  For the
+same reason the flight's trace spans classify its in-graph loop as
+dispatch-overlapped device time, not host sync: the flight-wide span
+carries site ``megastep.advance`` (a ``critpath`` dispatch site) and the
+fetch span carries site ``megastep.fetch.status``, which critpath treats
+as a marker (the fetch wall IS the device loop's wall; calling it
+``sync`` would tell the operator to attack a floor that is already paid
+exactly once).  The fetch-count guard still counts the fetch itself: the
+``host_fetch`` tag stays ``status``.
+
+Thread contract: ``solve`` runs on the CALLER's thread (the submit /
+HTTP handler thread) — the lowest-latency path has no queue hop and no
+device-loop round-trip — serialized per flight by the rank-36
+``serving.megastep`` lock, which is acquired holding at most the
+rank-30 engine lock and released before ``engine._finish_job`` (the SLO
+plane's rank-24 RLock must never be entered above rank 36).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.obs import compilewatch, lockdep, trace
+from distributed_sudoku_solver_tpu.ops.frontier import unpack_status
+from distributed_sudoku_solver_tpu.serving import engine as engine_mod
+from distributed_sudoku_solver_tpu.serving import faults
+from distributed_sudoku_solver_tpu.serving.scheduler import (
+    _REBASE_STEPS,
+    ResidentConfig,
+    _attach_jit,
+    _detach_jit,
+    _init_resident,
+    _verdict_jit,
+    resident_solver_config,
+)
+
+_LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class MegastepConfig:
+    """Static shape of a latency-mode flight (one per geometry).
+
+    ``chunk_steps * max_chunks`` is the flight's total step budget: a
+    board still holding work past it degrades to the chunked resident
+    path (which has no step budget, only deadlines).  ``chunk_steps``
+    is the inner early-exit granularity — smaller reacts faster to a
+    solve inside the loop, larger amortizes the per-chunk status pack;
+    neither changes the verdict (the search order is chunk-invariant,
+    pinned by the bit-identity test)."""
+
+    gang_lanes: int = 8  # lanes speculating on the one board
+    chunk_steps: int = 64  # frontier rounds per inner in-graph chunk
+    max_chunks: int = 64  # in-graph loop bound: the flight step budget
+
+    def __post_init__(self) -> None:
+        if self.gang_lanes < 1:
+            raise ValueError(f"gang_lanes must be >= 1, got {self.gang_lanes}")
+        if self.chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {self.chunk_steps}")
+        if self.max_chunks < 1:
+            raise ValueError(f"max_chunks must be >= 1, got {self.max_chunks}")
+
+
+class MegastepFlight:
+    """One geometry's latency-mode mailbox: a single-slot resident
+    frontier served synchronously, one donated megastep per job.
+
+    Raises ``ValueError`` from the constructor when the fused kernel
+    cannot serve the geometry at the gang width (same contract as the
+    resident scheduler — the engine counts the geometry unfit and
+    latency-mode submits fall through to the chunked paths)."""
+
+    def __init__(self, engine, geom: Geometry, cfg: MegastepConfig):
+        self.engine = engine
+        self.geom = geom
+        self.cfg = cfg
+        # The mailbox re-uses the resident seams end to end: the same
+        # shape-stable config derivation (fused-validated, no step
+        # budget — the in-graph loop bound is max_chunks, not
+        # max_steps), the same init/attach/detach/verdict programs.
+        self.config = resident_solver_config(
+            engine.config, geom,
+            ResidentConfig(
+                job_slots=1, gang_lanes=cfg.gang_lanes,
+                chunk_steps=cfg.chunk_steps,
+            ),
+        )
+        self.gang = self.config.steal_gang
+        if self.config.step_impl == "fused":
+            from distributed_sudoku_solver_tpu.ops.pallas_step import (
+                advance_megastep_fused,
+            )
+
+            self._advance_fn = advance_megastep_fused
+            self._advance_prog = compilewatch.ADVANCE_MEGASTEP_FUSED
+        else:
+            from distributed_sudoku_solver_tpu.ops.frontier import (
+                advance_megastep,
+            )
+
+            self._advance_fn = advance_megastep
+            self._advance_prog = compilewatch.ADVANCE_MEGASTEP
+        self.mailbox = None  # lockck: guard(_lock) — the device-resident frontier (lazy)
+        self._steps_seen = 0  # lockck: guard(_lock) — host copy of the frontier step counter
+        self._lock = lockdep.named_lock("serving.megastep")  # lockck: name(serving.megastep)
+        self.breaker = faults.CircuitBreaker(engine.recovery)
+        # Counters: flight outcomes (guarded — solve runs on arbitrary
+        # submit/handler threads, serialized only by _lock).
+        self.flights = 0  # lockck: guard(_lock)
+        self.flights_solved = 0  # lockck: guard(_lock)
+        self.flights_unsat = 0  # lockck: guard(_lock)
+        self.degraded_budget = 0  # lockck: guard(_lock) — max_chunks hit with work left
+        self.degraded_overflow = 0  # lockck: guard(_lock) — stack overflow: verdict untrusted
+        self.degraded_fault = 0  # lockck: guard(_lock) — device program failed (classified)
+        self.breaker_deflected = 0  # lockck: guard(_lock)
+        self.chunks_total = 0  # lockck: guard(_lock) — in-graph chunks across flights
+        # Round/wall totals for the device-efficiency gauge (the
+        # engine's cost-plane loop adds these like the resident ones).
+        self.rounds_total = 0  # lockck: guard(_lock)
+        self.round_wall_total = 0.0  # lockck: guard(_lock)
+        from distributed_sudoku_solver_tpu.utils.profiling import StatWindow
+
+        self.flight_wall = StatWindow()  # whole-flight seconds (the one sync included)
+
+    # -- the one serving surface ----------------------------------------------
+    def solve(self, job) -> bool:
+        """Serve ``job`` as one megastep flight on the calling thread.
+
+        True  -> the job is RESOLVED (solved or proven unsat) and
+                 ``engine._finish_job`` has run.
+        False -> degrade: the job was not touched (no partial results) —
+                 the caller must route it to the chunked paths.
+        """
+        if not self.breaker.allow():
+            with self._lock:  # submit threads race on the counter
+                self.breaker_deflected += 1
+            return False
+        verdict: Optional[tuple] = None
+        wall = 0.0
+        # Resolve the obs-plane singletons BEFORE taking the flight
+        # lock: the lookups acquire nothing, and keeping every
+        # cross-module call out of the locked region keeps the static
+        # lock graph exact (deadck resolves bare ``active`` by name).
+        rec = trace.active()
+        cw = compilewatch.active()
+        inj = faults.active()
+        with self._lock:
+            try:
+                verdict = self._fly_locked(job, rec, cw, inj)
+            except Exception as exc:  # noqa: BLE001 - degrade, never error the job
+                kind = faults.classify(exc)
+                self.degraded_fault += 1
+                self.breaker.record_failure()
+                # The donated mailbox did not survive the failed program:
+                # drop it (rebuilt lazily on the next flight).
+                self.mailbox = None
+                self._steps_seen = 0
+                _LOG.warning(
+                    "[megastep] flight failed for %s (%s: %r) — degrading "
+                    "to the chunked path", job.uuid, kind, exc,
+                )
+                return False
+            self.breaker.record_success()
+            self.flights += 1
+            info, chunks, nodes, sol_counts, overflowed, solutions, wall = verdict
+            self.chunks_total += chunks
+            delta = int(info["steps"]) - self._steps_seen  # syncck: allow(info is the unpack_status dict fetched in _fly_locked — host data across the return)
+            self._steps_seen = int(info["steps"])  # syncck: allow(same host dict — the one flight fetch already happened)
+            if delta > 0:
+                self.rounds_total += delta
+                self.round_wall_total += wall
+            if bool(info["solved"][0]):
+                self.flights_solved += 1
+            elif not bool(info["has_work"][0]) and not bool(overflowed[0]):
+                self.flights_unsat += 1
+            elif bool(info["has_work"][0]):
+                self.degraded_budget += 1
+                return False
+            else:
+                self.degraded_overflow += 1
+                return False
+        # Outside the flight lock: _finish_job enters the SLO plane's
+        # rank-24 RLock, which must never nest above our rank 36.
+        self.flight_wall.record(wall)
+        self.engine.hist["frontdoor_megastep_ms"].record(wall)
+        if bool(info["solved"][0]):
+            job.solved = True
+            job.solution = np.asarray(solutions[0], np.int32)  # syncck: allow(host_fetch-ed in _fly_locked — numpy no-op on host data)
+            job.sol_count = int(sol_counts[0])  # syncck: allow(host_fetch-ed in _fly_locked)
+        else:
+            # Space exhausted, no overflow: a complete proof (the
+            # megastep never sheds), same verdict rule as the resident
+            # collect path.
+            job.exhausted = True
+            job.unsat = True
+        job.nodes = int(nodes[0])  # syncck: allow(host_fetch-ed in _fly_locked)
+        self.engine._finish_job(job)
+        return True
+
+    def _fly_locked(self, job, rec, cw, inj) -> tuple:
+        """One flight under the lock: attach -> megastep -> verdict ->
+        the ONE host fetch -> detach.  Returns the host-side payload.
+        ``rec``/``cw``/``inj`` are the caller's pre-lock obs-plane
+        lookups (trace recorder, compile watch, fault injector)."""
+        t0 = self.engine._clock()
+        geom, config = self.geom, self.config
+        # Rebase the monotone step counter well before int32 overflow
+        # (the scheduler's trick: limits and status baselines are
+        # relative, so a reset between flights is invisible).
+        if self.mailbox is not None and self._steps_seen > _REBASE_STEPS:
+            self.mailbox = self.mailbox._replace(
+                steps=jnp.int32(0),
+                lane_rounds=jnp.zeros_like(self.mailbox.lane_rounds),
+            )
+            self._steps_seen = 0
+        if self.mailbox is None:
+            self.mailbox = _init_resident(geom, config, 1)
+            self._steps_seen = 0
+        if rec is not None:
+            t_att = rec.now()
+            rec.record(
+                job.uuid, "admission", "megastep.attach",
+                t0=job.trace_t0 if job.trace_t0 is not None else t_att,
+                t1=t_att, node=self.engine.trace_node, route="megastep",
+            )
+        if inj is not None:
+            faults.fire("megastep.advance", uuids=(job.uuid,))
+        tr0 = rec.now() if rec is not None else 0.0
+        # The donated attach is the mailbox write; the megastep is the
+        # whole flight as one dispatch.  Scalars are jnp-pinned (jaxck's
+        # weak-type rule) and TRACED, so retuning chunk_steps/max_chunks
+        # never recompiles.
+        self.mailbox = _attach_jit(
+            self.mailbox, jnp.asarray(job.grid[None], jnp.int32),
+            jnp.zeros(1, jnp.int32), geom, self.gang,
+        )
+        self.mailbox, status_dev, chunks_dev = self._advance_fn(
+            self.mailbox, jnp.int32(self.cfg.chunk_steps),
+            jnp.int32(self.cfg.max_chunks), geom, config,
+        )
+        verdict_dev = _verdict_jit(self.mailbox)
+        if cw is not None and self.flights == 0:
+            # Cost-plane seam (obs/compilewatch.py), the serving loops'
+            # twin: once per (program, shape) — ``.lower()`` re-traces on
+            # the host (aval shapes only, no device sync; the fetch-count
+            # guard runs with the watch installed to prove it).
+            lanes = self.config.lanes
+            cw.capture_cost(
+                self._advance_prog,
+                (geom.n, lanes, config.stack_slots, config.step_impl,
+                 "megastep"),
+                lambda: self._advance_fn.lower(
+                    self.mailbox, jnp.int32(self.cfg.chunk_steps),
+                    jnp.int32(self.cfg.max_chunks), geom, config,
+                ),
+                geometry=f"{geom.n}x{geom.n}", lanes=lanes,
+                chunk_steps=self.cfg.chunk_steps,
+                max_chunks=self.cfg.max_chunks,
+            )
+        # The flight's ONE host sync: status word + early-exit chunk
+        # count + the verdict payload, one batched fetch (tag "status" —
+        # the fetch-count guard's megastep lane counts exactly one per
+        # flight).  Blocking here waits out the in-graph loop: that wall
+        # is device compute plus ONE floor, recorded whole-flight in
+        # frontdoor_megastep_ms (never the per-chunk seams — see the
+        # module docstring's accounting contract).
+        tr1 = rec.now() if rec is not None else 0.0
+        raw_status, chunks, nodes, sol_counts, overflowed, solutions = (
+            engine_mod.host_fetch(
+                (status_dev, chunks_dev) + verdict_dev,
+                floor_s=self.engine.handicap_s,
+                tag="status",
+            )
+        )
+        wall = self.engine._clock() - t0
+        if rec is not None:
+            # Site megastep.fetch.status is a critpath MARKER, and the
+            # flight-wide span below is a DISPATCH site: the in-graph
+            # loop decomposes as dispatch-overlapped device time, not
+            # host sync (the round-19 decompose pin).
+            rec.record(
+                None, "megastep.sync", "megastep.fetch.status", tr1,
+                node=self.engine.trace_node, uuids=[job.uuid],
+                chunks=int(chunks),
+            )
+            rec.record(
+                None, "megastep.chunk.dispatch", "megastep.advance", tr0,
+                node=self.engine.trace_node, uuids=[job.uuid],
+                chunks=int(chunks), geometry=f"{geom.n}x{geom.n}",
+            )
+        info = unpack_status(raw_status, 1)
+        # Async teardown: the slot is recycled without another sync.
+        self.mailbox = _detach_jit(self.mailbox, jnp.ones(1, bool))
+        return (
+            info, int(chunks), nodes, sol_counts, overflowed, solutions,
+            wall,
+        )
+
+    # -- reads ----------------------------------------------------------------
+    def metrics(self) -> dict:
+        with self._lock:
+            out = {
+                "gang_lanes": int(self.gang),
+                "chunk_steps": int(self.cfg.chunk_steps),
+                "max_chunks": int(self.cfg.max_chunks),
+                "flights": int(self.flights),
+                "solved": int(self.flights_solved),
+                "unsat": int(self.flights_unsat),
+                "degraded": {
+                    "budget": int(self.degraded_budget),
+                    "overflow": int(self.degraded_overflow),
+                    "fault": int(self.degraded_fault),
+                    "breaker": int(self.breaker_deflected),
+                },
+                "chunks_total": int(self.chunks_total),
+            }
+            if self.flights > 0:
+                out["chunks_per_flight"] = round(
+                    self.chunks_total / self.flights, 2
+                )
+        fw = self.flight_wall.snapshot()
+        if fw:
+            out["flight_wall_ms"] = {
+                "count": fw["count"],
+                **{k: round(fw[k] * 1e3, 3) for k in ("p50", "p95")},
+            }
+        out["breaker"] = self.breaker.metrics()
+        return out

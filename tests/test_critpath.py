@@ -115,6 +115,66 @@ def test_decompose_edge_cases():
     _assert_partition(d)
 
 
+def test_decompose_megastep_flight_is_dispatch_not_sync():
+    """The round-16 decompose pin (ISSUE 16 accounting contract): a
+    megastep flight's in-graph loop time classifies as DISPATCH-
+    overlapped device work, never host sync.  The flight blocks the host
+    in ``host_fetch``, but that wall IS the device loop plus exactly one
+    floor — calling it sync would tell the operator to attack a floor
+    the megastep already pays once.  The flight-wide span carries the
+    dispatch site ``megastep.advance``; the fetch span's site
+    ``megastep.fetch.status`` is a MARKER (claims no time), deliberately
+    NOT in ``_SYNC_SITES``."""
+    assert critpath.classify(
+        _span("megastep.sync", "megastep.fetch.status", 0, 1)
+    ) is None
+    assert critpath.classify(
+        _span("megastep.chunk.dispatch", "megastep.advance", 0, 1)
+    ) == "dispatch"
+    spans = [
+        _span("admission", "megastep.attach", 0.0, 1.0),
+        # The whole flight as one dispatch span; the fetch marker sits
+        # inside it (the sync blocked 2.3->2.5 of device-loop wall).
+        _span("megastep.chunk.dispatch", "megastep.advance", 1.0, 2.5),
+        _span("megastep.sync", "megastep.fetch.status", 2.3, 2.5),
+        _span("resolve", "engine.resolve", 2.5, 2.5),
+    ]
+    d = critpath.decompose(spans)
+    assert d["end_to_end_ms"] == pytest.approx(2500.0)
+    p = d["phases_ms"]
+    assert p["queue"] == pytest.approx(1000.0)
+    assert p["dispatch"] == pytest.approx(1500.0)  # the whole flight
+    assert p.get("sync", 0.0) == pytest.approx(0.0)  # NOT host sync
+    _assert_partition(d)
+
+
+def test_live_megastep_trace_decomposes_as_dispatch():
+    """The same pin on a real flight: trace a latency-mode solve and
+    decompose its spans — the flight wall lands in dispatch, sync stays
+    zero, and the partition still sums to the end-to-end wall."""
+    from distributed_sudoku_solver_tpu.serving.megastep import MegastepConfig
+
+    rec = trace.TraceRecorder(ring=4096)
+    trace.install(rec)
+    eng = SolverEngine(
+        config=SMALL,
+        max_batch=8,
+        latency_mode=True,
+        megastep=MegastepConfig(gang_lanes=8, chunk_steps=2, max_chunks=64),
+    ).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+    finally:
+        eng.stop(timeout=2)
+        trace.install(None)
+    d = critpath.decompose(rec.spans(j.uuid))
+    assert d is not None
+    assert d["phases_ms"]["dispatch"] > 0.0
+    assert d["phases_ms"].get("sync", 0.0) == 0.0
+    _assert_partition(d)
+
+
 # -- monitor lane --------------------------------------------------------------
 
 

@@ -1,0 +1,256 @@
+"""Round-19 serving megastep (``serving/megastep.py``): latency-mode
+flights that fuse N advance chunks into ONE donated dispatch with an
+in-graph early exit, one host sync per flight.
+
+Four lanes:
+
+* **bit-identity** — the megastep's verdict (solved/unsat, the decoded
+  solution grid, sol_count) is bit-identical to the chunked path's on
+  the hard corpus, for both step implementations.  The in-graph loop
+  changes WHEN the host looks, never what the search computes.
+* **degrade-to-chunked** (round-9 taxonomy) — budget exhaustion, device
+  faults, and breaker deflection all return the job to the chunked
+  paths unharmed; every degrade is counted by cause and the job still
+  solves.
+* **routing contract** — latency is an opt-in: per-request ``latency=``
+  overrides the engine default in both directions, and an unfit gang
+  shape (``resident_solver_config`` misfit) is counted once and
+  bypassed forever, never an error.
+* **accounting** — the flight's single sync lands in
+  ``frontdoor_megastep_ms`` ONLY: the per-chunk ``chunk_wall_ms``/
+  ``sync_wall_ms`` seams and the ``rpc_floor`` estimator stay empty on
+  an engine that only flew megasteps (the round-19 double-count sweep).
+
+The one-sync-per-flight fetch-count guard itself lives in
+``tests/test_status_pipeline.py`` (the megastep lane), beside the
+per-chunk guards it extends.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.serving import faults
+from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.megastep import MegastepConfig
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+SMALL = SolverConfig(min_lanes=8, stack_slots=16)
+FUSED_SMALL = SolverConfig(
+    min_lanes=8, stack_slots=16, step_impl="fused", fused_steps=2
+)
+MS = MegastepConfig(gang_lanes=8, chunk_steps=16, max_chunks=64)
+
+
+def _solve_chunked(cfg, boards):
+    """The chunked baseline: the same boards through a resident-flight
+    engine (no megastep installed at all).  The resident collect path is
+    the megastep's verdict twin — the same ``_verdict_jit`` payload, the
+    same ``sol_count`` contract (exactly 1 for a solved job in normal
+    mode; the static finalize path predates that contract and may report
+    0 for a job purged at its solve chunk)."""
+    from distributed_sudoku_solver_tpu.serving.scheduler import ResidentConfig
+
+    rc = ResidentConfig(
+        job_slots=4, gang_lanes=4, queue_depth=32, attach_batch=4,
+        chunk_steps=16,
+    )
+    eng = SolverEngine(config=cfg, max_batch=8, resident=rc).start()
+    try:
+        jobs = [eng.submit(np.asarray(b, np.int32)) for b in boards]
+        for j in jobs:
+            assert j.wait(240), j.error
+    finally:
+        eng.stop(timeout=2)
+    return jobs
+
+
+# -- bit-identity -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cfg", [SMALL, FUSED_SMALL], ids=["xla", "fused"])
+def test_verdict_bit_identical_to_chunked(cfg):
+    boards = [np.asarray(b) for b in HARD_9] + [np.asarray(EASY_9)]
+    base = _solve_chunked(cfg, boards)
+    eng = SolverEngine(
+        config=cfg, max_batch=8, latency_mode=True, megastep=MS
+    ).start()
+    try:
+        for b, ref in zip(boards, base):
+            j = eng.submit(np.asarray(b, np.int32))
+            assert j.wait(240), j.error
+            assert j.solved == ref.solved and j.unsat == ref.unsat
+            np.testing.assert_array_equal(
+                np.asarray(j.solution), np.asarray(ref.solution)
+            )
+            assert j.sol_count == ref.sol_count
+        mf = eng._megasteps[SUDOKU_9]
+        m = mf.metrics()
+        # Every board flew; none degraded to the chunked path.
+        assert m["flights"] == len(boards) and m["solved"] == len(boards)
+        assert all(v == 0 for v in m["degraded"].values())
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_unsat_board_proven_on_the_megastep():
+    bad = np.zeros((9, 9), np.int32)
+    bad[0, 0] = bad[0, 1] = 5
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, latency_mode=True, megastep=MS
+    ).start()
+    try:
+        j = eng.submit(bad)
+        assert j.wait(120)
+        assert j.unsat and j.exhausted and not j.solved
+        m = eng._megasteps[SUDOKU_9].metrics()
+        # A complete proof (all-dead early exit), not a shed/degrade.
+        assert m["unsat"] == 1 and m["flights"] == 1
+        assert all(v == 0 for v in m["degraded"].values())
+    finally:
+        eng.stop(timeout=2)
+
+
+# -- degrade-to-chunked (round-9 taxonomy) ------------------------------------
+
+
+def test_budget_exhaustion_degrades_to_chunked():
+    """A flight that exhausts max_chunks with work left returns False and
+    the CHUNKED path (which has no step budget) finishes the job; the
+    degrade is counted under its cause."""
+    tiny = MegastepConfig(gang_lanes=8, chunk_steps=1, max_chunks=1)
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, latency_mode=True, megastep=tiny
+    ).start()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+        m = eng._megasteps[SUDOKU_9].metrics()
+        assert m["degraded"]["budget"] == 1
+        assert m["flights"] == 1 and m["solved"] == 0  # flew, didn't finish
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_fault_degrades_and_breaker_deflects():
+    """A device fault mid-flight degrades the job to the chunked path
+    (counted under 'fault', mailbox rebuilt); consecutive failures trip
+    the flight's circuit breaker, after which latency submits deflect in
+    O(1) WITHOUT touching the device — and every job still solves."""
+    inj = faults.FaultInjector(
+        faults.FaultSchedule.at(
+            {"megastep.advance": {0: "preempt", 1: "preempt"}}
+        )
+    )
+    eng = SolverEngine(
+        config=SMALL,
+        max_batch=8,
+        latency_mode=True,
+        megastep=MS,
+        recovery=faults.RecoveryPolicy(
+            breaker_failures=2, breaker_cooldown_s=600.0
+        ),
+    ).start()
+    try:
+        with faults.injected(inj):
+            jobs = [eng.submit(HARD_9[i % 3]) for i in range(3)]
+            for j in jobs:
+                assert j.wait(120) and j.solved, j.error
+        m = eng._megasteps[SUDOKU_9].metrics()
+        assert m["degraded"]["fault"] == 2
+        assert m["degraded"]["breaker"] == 1
+        assert m["flights"] == 0  # no flight ever completed
+        assert m["breaker"]["state"] == "open"
+        # The chunked fallback pays its own seams (engine.launch /
+        # engine.advance / fetch.*); the MEGASTEP seam saw exactly the
+        # two faulted flights — the deflected submit never reached it.
+        assert inj.metrics()["dispatches"].get("megastep.advance") == 2
+    finally:
+        eng.stop(timeout=2)
+
+
+# -- routing contract ---------------------------------------------------------
+
+
+def test_per_request_latency_overrides_engine_default():
+    # Engine default OFF, per-request opt-IN:
+    eng = SolverEngine(config=SMALL, max_batch=8, megastep=MS).start()
+    try:
+        j1 = eng.submit(HARD_9[0], latency=True)
+        assert j1.wait(120) and j1.solved, j1.error
+        assert eng._megasteps[SUDOKU_9].flights == 1
+        j2 = eng.submit(HARD_9[1])  # default: the chunked path
+        assert j2.wait(120) and j2.solved, j2.error
+        assert eng._megasteps[SUDOKU_9].flights == 1
+    finally:
+        eng.stop(timeout=2)
+    # Engine default ON, per-request opt-OUT:
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, latency_mode=True, megastep=MS
+    ).start()
+    try:
+        j = eng.submit(HARD_9[0], latency=False)
+        assert j.wait(120) and j.solved, j.error
+        assert SUDOKU_9 not in eng._megasteps  # never even built
+    finally:
+        eng.stop(timeout=2)
+
+
+def test_unfit_gang_shape_counted_once_and_bypassed(monkeypatch):
+    """A geometry the megastep gang cannot serve (resident_solver_config
+    misfit) is counted ONCE, cached as unservable, and every latency
+    submit falls through to the chunked path — never an error."""
+    import distributed_sudoku_solver_tpu.serving.megastep as megastep_mod
+
+    def misfit(base, geom, rcfg):
+        raise ValueError("forced gang-shape misfit")
+
+    monkeypatch.setattr(megastep_mod, "resident_solver_config", misfit)
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, latency_mode=True, megastep=MS
+    ).start()
+    try:
+        jobs = [eng.submit(HARD_9[0]), eng.submit(HARD_9[1])]
+        for j in jobs:
+            assert j.wait(120) and j.solved, j.error
+        m = eng.metrics()
+        assert m["megastep_unfit"] == 1  # cached: not re-counted per submit
+        assert "megastep" not in m  # no live flight section
+    finally:
+        eng.stop(timeout=2)
+
+
+# -- accounting: the single sync lands in ONE place ---------------------------
+
+
+def test_single_sync_never_double_counted():
+    """The megastep's one fetch is recorded whole-flight in
+    frontdoor_megastep_ms and NOWHERE else: the per-chunk chunk/sync
+    walls and the rpc_floor estimator (whose samples mean 'one chunk's
+    sync' / 'one floor') stay empty on an engine that only flew
+    megasteps."""
+    eng = SolverEngine(
+        config=SMALL, max_batch=8, latency_mode=True, megastep=MS
+    ).start()
+    try:
+        for b in (HARD_9[0], EASY_9):
+            j = eng.submit(np.asarray(b, np.int32))
+            assert j.wait(120) and j.solved, j.error
+        m = eng.metrics()
+        ms = m["megastep"]["9x9"]
+        assert ms["flights"] == 2 and ms["solved"] == 2
+        assert ms["chunks_per_flight"] >= 1
+        assert ms["flight_wall_ms"]["count"] == 2
+        assert sum(m["hist"]["frontdoor_megastep_ms"]["counts"]) == 2
+        # The round-19 double-count sweep: nothing leaked into the
+        # per-chunk seams or the floor estimator.
+        assert not eng.chunk_wall.snapshot()
+        assert not eng.sync_wall.snapshot()
+        # The hist section drops empty families: the per-chunk seams
+        # must simply be absent on a megastep-only engine.
+        assert "chunk_wall_ms" not in m["hist"]
+        assert "sync_wall_ms" not in m["hist"]
+        assert "rpc_floor_ms" not in m
+    finally:
+        eng.stop(timeout=2)

@@ -326,6 +326,14 @@ PROM_SAMPLE = {
             "counts": [0] * 11 + [7, 5] + [0] * 19,
             "sum_ms": 51.75,
         },
+        # Round-19 whole-flight megastep latency: exactly ONE sample per
+        # flight (the one-sync-per-flight proof rides this count).
+        "frontdoor_megastep_ms": {
+            "type": "log2_hist",
+            "edge0_ms": 0.001,
+            "counts": [0] * 16 + [30, 19] + [0] * 14,
+            "sum_ms": 3917.4,
+        },
     },
     "rpc_floor_ms": {"type": "min_est", "min": 48.9, "recent": 50.2,
                      "samples": 210},
@@ -386,7 +394,7 @@ PROM_SAMPLE = {
             },
             "unregistered": {"count": 3, "wall_ms_total": 40.25},
         },
-        "registered": 21,
+        "registered": 23,
         "compiles_total": 4,
         "recompiles_total": 0,
         "warmup_over": True,
@@ -432,6 +440,36 @@ PROM_SAMPLE = {
             "canonical_dups": 9,
         },
     },
+    # Round-19 serving-megastep section (serving/megastep.py): per-
+    # geometry flight counters with the nested degrade taxonomy, the
+    # chunks-per-flight gauge, the whole-flight wall window, and the
+    # flight breaker's string-state leaf — plus the engine-level
+    # unfit-gang-shape counter.
+    "megastep": {
+        "9x9": {
+            "gang_lanes": 8,
+            "chunk_steps": 64,
+            "max_chunks": 64,
+            "flights": 49,
+            "solved": 49,
+            "unsat": 0,
+            "degraded": {
+                "budget": 0,
+                "overflow": 0,
+                "fault": 0,
+                "breaker": 0,
+            },
+            "chunks_total": 49,
+            "chunks_per_flight": 1.0,
+            "flight_wall_ms": {"count": 49, "p50": 68.096, "p95": 92.355},
+            "breaker": {
+                "state": "closed",
+                "consecutive_failures": 0,
+                "transitions": 0,
+            },
+        },
+    },
+    "megastep_unfit": 1,
     "critpath": {
         "jobs": 12,
         "attribution_ms": {
@@ -492,8 +530,10 @@ def test_promck_over_live_prometheus_endpoint():
     the histogram sections populated by a real solve, the round-15
     compile/cost/critpath planes installed, AND the round-17 front door
     routing real traffic (a device-routed hard board, a propagation-
-    answered easy board, and a symmetry-transformed cache hit) — passes
-    promck and carries the frontdoor families."""
+    answered easy board, and a symmetry-transformed cache hit), AND the
+    round-19 latency mode flying the device-routed board on a real
+    megastep — passes promck and carries the frontdoor + megastep
+    families."""
     import urllib.request
 
     import numpy as np
@@ -510,6 +550,7 @@ def test_promck_over_live_prometheus_endpoint():
         ApiServer,
         StandaloneNode,
     )
+    from distributed_sudoku_solver_tpu.serving.megastep import MegastepConfig
     from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
     from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
 
@@ -520,7 +561,8 @@ def test_promck_over_live_prometheus_endpoint():
     mon = critpath.CritPathMonitor()
     eng = SolverEngine(
         config=SMALL, max_batch=8, chunk_steps=4,
-        frontdoor=FrontDoorConfig(),
+        frontdoor=FrontDoorConfig(), latency_mode=True,
+        megastep=MegastepConfig(gang_lanes=8, chunk_steps=4, max_chunks=64),
     ).start()
     ctrl = brownout.BrownoutController()
     brownout.bind_engine(ctrl, eng)
@@ -537,6 +579,12 @@ def test_promck_over_live_prometheus_endpoint():
             )
             jc = eng.submit(transformed)  # symmetry-canonical cache hit
             assert jc.wait(30) and jc.solved and jc.route == "cache"
+            # A per-request latency OPT-OUT on a latency-mode engine:
+            # this hard board takes the CHUNKED device path, which is
+            # what feeds the rpc_floor estimator (the megastep's single
+            # whole-flight sync never does — by contract).
+            jk = eng.submit(HARD_9[0], latency=False)
+            assert jk.wait(120) and jk.solved, jk.error
             raw = (
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{api.port}/metrics?format=prometheus",
@@ -557,7 +605,7 @@ def test_promck_over_live_prometheus_endpoint():
     # program, the cost plane's efficiency gauge is live, and the
     # critical-path histograms joined the mergeable hist keyspace.
     assert "dsst_compile_compiles_total" in raw
-    assert "dsst_compile_registered 21" in raw
+    assert "dsst_compile_registered 23" in raw
     assert 'dsst_cost_programs_flops{program="advance_status"}' in raw
     assert "dsst_cost_efficiency_achieved_gflops_per_s" in raw
     assert "dsst_critpath_jobs" in raw
@@ -565,13 +613,24 @@ def test_promck_over_live_prometheus_endpoint():
     # Round-17 front-door families: route counters under the `route`
     # label, cache counters (the transformed resubmit is both a hit and
     # a canonical dup), and the per-route latency histograms in `hist`.
-    assert 'dsst_frontdoor_routes{route="device"} 1' in raw
+    assert 'dsst_frontdoor_routes{route="device"} 2' in raw
     assert 'dsst_frontdoor_routes{route="cache"} 1' in raw
     assert 'dsst_frontdoor_routes{route="propagation"} 1' in raw
     assert "dsst_frontdoor_cache_hits 1" in raw
     assert "dsst_frontdoor_cache_canonical_dups 1" in raw
     assert 'dsst_hist_frontdoor_cache_ms_bucket{le="+Inf"} 1' in raw
-    assert 'dsst_hist_frontdoor_device_ms_bucket{le="+Inf"} 1' in raw
+    assert 'dsst_hist_frontdoor_device_ms_bucket{le="+Inf"} 2' in raw
+    # Round-19 megastep families: the hard board flew on the megastep
+    # (the front door still counted it as route=device — latency mode
+    # changes the DISPATCH, not the routing verdict), its one sync is
+    # the single whole-flight histogram sample, and the flight breaker's
+    # string state renders as an info-style gauge.
+    assert 'dsst_megastep_flights{geometry="9x9"} 1' in raw
+    assert 'dsst_megastep_solved{geometry="9x9"} 1' in raw
+    assert 'dsst_megastep_degraded_budget{geometry="9x9"} 0' in raw
+    assert 'dsst_megastep_breaker_state{geometry="9x9",state="closed"} 1' in raw
+    assert 'dsst_hist_frontdoor_megastep_ms_bucket{le="+Inf"} 1' in raw
+    assert "dsst_hist_frontdoor_megastep_ms_count 1" in raw
     # Round-18 brownout families (serving/brownout.py): the stage gauge,
     # the tier-labeled shed table, and the transition counters render
     # from the LIVE controller (healthy here: stage 0, nothing shed).

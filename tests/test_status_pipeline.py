@@ -398,6 +398,86 @@ def test_resident_loop_exactly_one_sync_per_chunk(counted_fetches, tracing):
         assert adm and adm[0]["attrs"]["route"] == "resident"
 
 
+# -- the megastep lane (round 19): ONE sync per FLIGHT ------------------------
+
+
+def _megastep_engine():
+    """A latency-mode engine whose megastep chunks are tiny (2 steps), so
+    a hard board NEEDS several in-graph chunks — proving the fused loop
+    really looped while the host fetched once."""
+    from distributed_sudoku_solver_tpu.serving.megastep import MegastepConfig
+
+    return SolverEngine(
+        config=SMALL,
+        max_batch=8,
+        latency_mode=True,
+        megastep=MegastepConfig(gang_lanes=8, chunk_steps=2, max_chunks=64),
+    ).start()
+
+
+def test_megastep_exactly_one_status_sync_per_flight(counted_fetches, tracing):
+    """The round-19 contract, the whole point of the megastep: a hard
+    board whose chunked flight costs one 'status' fetch PER CHUNK (the
+    static test above measures >=3) costs exactly ONE host sync for the
+    entire flight — the in-graph ``lax.while_loop`` consumed the chunks,
+    and the single batched fetch carried status + chunk count + verdict.
+    No event fetch, no finalize, nothing else.  Runs under all four
+    obs-plane variants (untraced / traced / watched / lockdep): every
+    plane promises zero added syncs, and the lockdep variant additionally
+    proves the rank-36 flight lock nests violation-free."""
+    eng = _megastep_engine()
+    try:
+        j = eng.submit(HARD_9[1])
+        assert j.wait(120) and j.solved, j.error
+        mf = eng._megasteps[SUDOKU_9]
+        flights, chunks = mf.flights, mf.chunks_total
+    finally:
+        eng.stop(timeout=2)
+    assert flights == 1
+    assert chunks >= 3, "workload too easy to exercise the in-graph loop"
+    assert counted_fetches == ["status"], (
+        "a megastep flight must cost exactly one host sync", counted_fetches
+    )
+    if tracing is not None:
+        names = [s["name"] for s in tracing.spans()]
+        assert names.count("megastep.sync") == 1
+        assert names.count("megastep.chunk.dispatch") == 1
+        adm = [s for s in tracing.spans() if s["name"] == "admission"]
+        assert adm and adm[0]["attrs"]["route"] == "megastep"
+
+
+def test_megastep_early_exit_no_stale_verdict(counted_fetches):
+    """The in-graph loop exits on all-solved at some inner chunk k, not
+    at the max_chunks budget; and the post-loop verdict is the EXITED
+    state — back-to-back flights recycling the same device mailbox must
+    each fetch their own board's solution (a stale verdict from flight
+    N-1 leaking into flight N's fetch is the classic donation/aliasing
+    failure this pins)."""
+    from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+
+    boards = [HARD_9[1], HARD_9[0], EASY_9]
+    eng = _megastep_engine()
+    try:
+        sols = []
+        for b in boards:
+            j = eng.submit(b)
+            assert j.wait(120) and j.solved, j.error
+            sols.append(np.asarray(j.solution))
+        mf = eng._megasteps[SUDOKU_9]
+        assert mf.flights == len(boards)
+        # Early exit fired: the budget is 64 chunks/flight, a solved
+        # board stops the loop orders of magnitude earlier.
+        assert mf.chunks_total < len(boards) * mf.cfg.max_chunks / 2
+    finally:
+        eng.stop(timeout=2)
+    assert counted_fetches == ["status"] * len(boards), counted_fetches
+    for b, sol in zip(boards, sols):
+        assert is_valid_solution(sol)
+        clues = np.asarray(b, np.int32)
+        np.testing.assert_array_equal(sol[clues > 0], clues[clues > 0])
+    assert not np.array_equal(sols[0], sols[1]), "stale verdict leaked"
+
+
 # -- padded-bucket job dimension (flight frontiers pad to a power of two) -----
 
 
