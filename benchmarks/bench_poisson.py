@@ -485,6 +485,183 @@ def compare_poisson(
     return out
 
 
+def ring_pass(
+    mix: dict,
+    mean_gap_s: float,
+    handicap_s: float,
+    chunk_steps: int,
+    seed: int,
+    ring_nodes: int = 3,
+    timeout: float = 600.0,
+) -> dict:
+    """The DHT tier (ISSUE 17 satellite): the SAME mixed-difficulty stream,
+    round-robined across a ``ring_nodes``-member gossip ring over
+    ``cluster/simnet.py`` — each member a full front-door engine whose L2
+    seam reads through the cluster-wide result cache.
+
+    Measured: the **cluster-cache hit rate** (fraction of submissions
+    answered from cache, L1 or L2, anywhere in the ring) against the
+    **best per-node rate** from a CONTROL pass — the identical stream,
+    identically round-robined, over ``ring_nodes`` *independent* front
+    doors with no cluster behind them.  The control is what makes the
+    comparison honest: inside the DHT run every L2 hit is promoted into
+    the requester's L1, so the ring's own L1 rates are themselves a
+    product of the DHT and cannot serve as the no-DHT baseline.
+    Round-robin means each member sees only 1/``ring_nodes`` of every
+    repeated orbit — the gap between the two rates IS the value of
+    sharing fills through the DHT.
+
+    Wire delivery and gossip run on the simnet virtual clock (pumped from
+    a background thread); engine device loops stay on the wall clock, as
+    everywhere in the simnet lane.
+    """
+    from distributed_sudoku_solver_tpu.cluster.node import (
+        ClusterConfig,
+        ClusterNode,
+    )
+    from distributed_sudoku_solver_tpu.cluster.simnet import SimNet, wait_until
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.serving.frontdoor.router import (
+        FrontDoorConfig,
+    )
+
+    boards, tiers = mixed_corpus(mix, seed)
+    cfg = SolverConfig(min_lanes=8, stack_slots=16)
+
+    def _engine() -> SolverEngine:
+        return SolverEngine(
+            config=cfg,
+            max_batch=8,
+            handicap_s=handicap_s,
+            chunk_steps=chunk_steps,
+            frontdoor=FrontDoorConfig(),
+        ).start()
+
+    def _submit_round_robin(submit_fns) -> list:
+        rng = random.Random(seed)
+        jobs = []
+        for i, board in enumerate(boards):
+            jobs.append(submit_fns[i % len(submit_fns)](board))
+            time.sleep(rng.expovariate(1.0 / mean_gap_s))
+        deadline = time.monotonic() + timeout
+        for j in jobs:
+            assert j.wait(max(0.0, deadline - time.monotonic())), (
+                "ring job never resolved"
+            )
+            assert j.solved or j.unsat, f"ring job failed: {j.error!r}"
+        return jobs
+
+    # Control pass FIRST (it also warms the jit caches for the ring
+    # pass): independent front doors, no cluster — each member's cache
+    # fills only from its own 1/N of the stream.
+    solo = [_engine() for _ in range(ring_nodes)]
+    best_solo = 0.0
+    solo_rates = []
+    try:
+        w = solo[0].submit(boards[0], frontdoor=False)
+        assert w.wait(300), "control warm-up solve failed"
+        _submit_round_robin([e.submit for e in solo])
+        for e in solo:
+            fd = e.metrics()["frontdoor"]
+            n_jobs = sum(fd["routes"].values())
+            rate = (fd["routes"]["cache"] / n_jobs) if n_jobs else 0.0
+            solo_rates.append(round(rate, 4))
+            best_solo = max(best_solo, rate)
+    finally:
+        for e in solo:
+            e.stop(timeout=2)
+
+    ccfg = ClusterConfig(
+        heartbeat_s=0.25,
+        fail_factor=8.0,
+        io_timeout_s=2.0,
+        needwork=False,
+        progress_interval_s=0.0,
+        retry_delay_s=0.1,
+        tombstone_probe_s=600.0,
+    )
+    net = SimNet()
+    nodes: list = []
+    try:
+        for i in range(ring_nodes):
+            nodes.append(
+                ClusterNode(
+                    _engine(),
+                    anchor=nodes[0].addr if nodes else None,
+                    config=ccfg,
+                    transport=net.transport(),
+                    clock=net.clock,
+                ).start()
+            )
+        assert wait_until(
+            net,
+            lambda: all(len(n.network) == ring_nodes for n in nodes),
+            timeout=120,
+        ), "gossip ring never formed"
+
+        for n in nodes:  # warm the compile caches off the front door
+            w = n.engine.submit(boards[0], frontdoor=False)
+            assert w.wait(300), "ring warm-up solve failed"
+
+        # Pump virtual time while real submissions fire: gossip beats,
+        # retry sleeps and CACHE_PUT backoffs live on the simnet clock.
+        stop_pump = threading.Event()
+
+        def _pump():
+            while not stop_pump.is_set():
+                net.advance(0.25)
+                stop_pump.wait(0.002)
+
+        pump = threading.Thread(target=_pump, name="bench-ring-pump")
+        pump.start()
+        try:
+            _submit_round_robin([n.engine.submit for n in nodes])
+        finally:
+            stop_pump.set()
+            pump.join()
+
+        per_node: dict = {}
+        cache_routed = 0
+        l2 = {
+            "lookups": 0, "local_hits": 0, "remote_hits": 0,
+            "negative_hits": 0, "misses": 0, "puts_applied": 0,
+            "gets_served": 0, "remote_errors": 0,
+        }
+        for n in nodes:
+            fd = n.engine.frontdoor.metrics()
+            c = fd["cache"]
+            cache_routed += fd["routes"]["cache"]
+            dm = n.dcache.metrics()
+            for k in l2:
+                l2[k] += dm[k]
+            per_node[n.addr_s] = {
+                "jobs": sum(fd["routes"].values()),
+                "cache_routed": fd["routes"]["cache"],
+                "l1_hits": c["hits"],
+                "cluster_hits": fd["cluster_hits"],
+            }
+        return {
+            "nodes": ring_nodes,
+            "jobs": len(boards),
+            "mix": _mix_spec(mix),
+            # Cache-answered fraction across the whole ring (L1 or L2) —
+            # the rate a client sees wherever its request lands.
+            "cluster_hit_rate": round(cache_routed / len(boards), 4),
+            # The control pass's luckiest member: the ceiling a DHT-less
+            # deployment of the same ring could reach on this stream.
+            "best_node_hit_rate": round(best_solo, 4),
+            "solo_node_hit_rates": solo_rates,
+            "l2": l2,
+            "per_node": per_node,
+        }
+    finally:
+        for n in nodes:
+            n.kill()
+            n.engine.stop(timeout=2)
+        net.close()
+
+
 def main() -> None:
     import argparse
     import json
@@ -504,6 +681,21 @@ def main() -> None:
         "per-route/per-tier percentiles are reported.  --jobs is ignored "
         "(the mix counts size the corpus).  Artifacts with different "
         "mixes are non-comparable in benchmarks/regress.py (exit 2)",
+    )
+    ap.add_argument(
+        "--ring",
+        type=int,
+        default=0,
+        metavar="N",
+        help="also run the mixed stream round-robin across an N-member "
+        "gossip ring over cluster/simnet (ISSUE 17): each member is a "
+        "full front-door engine reading through the cluster-wide result "
+        "cache; reports the ring's cluster-cache hit rate vs the best "
+        "per-node rate of a no-DHT control pass (same stream over N "
+        "independent front doors).  Requires --mix (the repeats are what "
+        "the cache shares); adds a 'ring' section to the report/artifact "
+        "which benchmarks/regress.py gates whenever both artifacts "
+        "carry it with the same node count",
     )
     ap.add_argument(
         "--latency-mode",
@@ -538,6 +730,10 @@ def main() -> None:
         "deterministic trace-replay capacity planner",
     )
     args = ap.parse_args()
+    if args.ring and not args.mix:
+        ap.error("--ring requires --mix (repeats are what the cache shares)")
+    if args.ring and args.ring < 3:
+        ap.error("--ring needs at least 3 members to measure sharing")
 
     rec = None
     if args.trace_out:
@@ -569,6 +765,15 @@ def main() -> None:
             record_workload=bool(args.workload_out),
             latency_mode=args.latency_mode,
         )
+        if args.ring:
+            out["ring"] = ring_pass(
+                parse_mix(args.mix),
+                mean_gap_s=args.mean_ms / 1e3,
+                handicap_s=args.handicap_ms / 1e3,
+                chunk_steps=args.chunk_steps,
+                seed=args.seed,
+                ring_nodes=args.ring,
+            )
     finally:
         compilewatch_mod.install(None)
         if rec is not None:
@@ -667,6 +872,11 @@ def main() -> None:
                 if args.latency_mode
                 else {}
             ),
+            # The DHT tier (round 20): additive like megastep — params
+            # stay unchanged, regress.py gates the ring hit rates only
+            # when both artifacts carry the section with equal node
+            # counts.
+            **({"ring": out["ring"]} if args.ring else {}),
         }
         tmp = args.out_json + ".tmp"
         with open(tmp, "w") as f:
@@ -729,6 +939,26 @@ def main() -> None:
                 f"  frontdoor: routes={fd.get('routes')} cache_hits={c.get('hits')}"
                 f" canonical_dups={c.get('canonical_dups')}"
                 f" native_fallback_wins={fd.get('native_fallback_wins')}"
+            )
+    if "ring" in out:
+        r = out["ring"]
+        print(
+            f"ring ({r['nodes']} members, {r['jobs']} jobs round-robin): "
+            f"cluster_hit_rate={r['cluster_hit_rate']} vs "
+            f"best_node_hit_rate={r['best_node_hit_rate']}"
+        )
+        print(
+            f"  l2: remote_hits={r['l2']['remote_hits']} "
+            f"local_hits={r['l2']['local_hits']} "
+            f"negative_hits={r['l2']['negative_hits']} "
+            f"puts_applied={r['l2']['puts_applied']}"
+        )
+        if r["cluster_hit_rate"] <= r["best_node_hit_rate"]:
+            print(
+                "  WARNING: the DHT added nothing over the best member's "
+                "own cache on this stream — expected only for repeat-free "
+                "mixes",
+                file=sys.stderr,
             )
 
 

@@ -30,6 +30,14 @@ it.  The section is additive — params are unchanged, so a latency-mode
 artifact still compares against a pre-round-19 artifact on the
 static/resident sides (with a note that the new tier went ungated).
 
+Ring artifacts (``bench_poisson --ring N``, round 20) carry a ``ring``
+section: the gossip ring's cluster-cache hit rate vs the best per-node
+L1 rate.  Gated like megastep — both sides must carry it, with equal
+node counts (a different ring size is a different deployment, noted not
+gated).  Two checks: the cluster hit rate must not drop past the
+tolerance, and it must still strictly exceed the best per-node rate
+(the DHT's reason to exist).
+
 Mixed-corpus artifacts (``bench_poisson --mix``, round 17) are only
 comparable to artifacts with the *identical* mix: the overall quantiles
 blend cache/native/device routes in mix-specific proportions, so a
@@ -179,6 +187,51 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
             f"only the {only} artifact carries the megastep "
             "(latency-mode) tier — that tier is NOT gated; run both "
             "sides with --latency-mode to gate it"
+        )
+    # The DHT tier (bench_poisson --ring, round 20): the ring's
+    # cluster-cache hit rate is gated DOWNWARD (a rate drop past the
+    # tolerance is a regression — the cache stopped sharing), and the
+    # cluster-vs-best-node gap must stay positive: a ring whose DHT no
+    # longer beats its luckiest single member has lost the subsystem's
+    # whole point.  Different node counts measure a different deployment
+    # — noted, not gated (same doctrine as replay scaling knobs).
+    has_ring = {
+        label: isinstance(doc.get("ring"), dict)
+        for label, doc in (("old", old), ("new", new))
+    }
+    if all(has_ring.values()):
+        o_ring, n_ring = old["ring"], new["ring"]
+        if o_ring.get("nodes") != n_ring.get("nodes"):
+            notes.append(
+                f"ring node counts differ ({o_ring.get('nodes')} vs "
+                f"{n_ring.get('nodes')}) — a different deployment shape; "
+                "the ring tier is NOT gated"
+            )
+        else:
+            o_hit = float(o_ring.get("cluster_hit_rate", 0.0))
+            n_hit = float(n_ring.get("cluster_hit_rate", 0.0))
+            if o_hit > 0 and n_hit < o_hit * (1.0 - tol):
+                regressions.append(
+                    f"ring cluster_hit_rate: {o_hit:.3f} -> {n_hit:.3f} "
+                    f"({(n_hit / o_hit - 1) * 100:.0f}%, tolerance "
+                    f"{tol * 100:.0f}%)"
+                )
+            elif o_hit > 0 and n_hit > o_hit * (1.0 + tol):
+                improvements.append(
+                    f"ring cluster_hit_rate: {o_hit:.3f} -> {n_hit:.3f}"
+                )
+            n_best = float(n_ring.get("best_node_hit_rate", 0.0))
+            if n_hit <= n_best:
+                regressions.append(
+                    f"ring cluster_hit_rate ({n_hit:.3f}) no longer "
+                    f"exceeds the best per-node rate ({n_best:.3f}) — "
+                    "the DHT stopped sharing fills"
+                )
+    elif any(has_ring.values()):
+        only = "old" if has_ring["old"] else "new"
+        notes.append(
+            f"only the {only} artifact carries the ring (DHT) tier — "
+            "that tier is NOT gated; run both sides with --ring to gate it"
         )
     for side in sides:
         for q in QUANTS:

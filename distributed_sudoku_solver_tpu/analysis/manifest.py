@@ -76,6 +76,17 @@ LAYERS = {
         "allow": ("cluster.wire", "serving.faults", "obs.lockdep"),
         "third_party": (),
     },
+    # The DHT plane (cluster/dht/, ISSUE 17) is a closed stdlib layer:
+    # gossip membership, the consistent-hash ring, and the cluster-cache
+    # shard are pure protocol state machines over injected seams (clock,
+    # owner_fn, request_fn) — no jax, no numpy, no serving.  Entries
+    # cross this layer as plain dicts; the CacheEntry glue lives in
+    # cluster/node.py, which may import anything cluster already does.
+    "cluster.dht": {
+        "closed": True,
+        "allow": ("cluster.dht", "cluster.wire", "obs.lockdep"),
+        "third_party": (),
+    },
     # The checker's own layer: source-only tooling.  stdlib + obs (the
     # shared *ck exit-code contract) — importing jax here would break the
     # "<5 s, no jax" acceptance the tier-1 test pins.
@@ -582,6 +593,12 @@ JAXCK_CALLBACK_CARVEOUTS: dict = {}
 #       lock, and simnet's delivery path calls handlers only OUTSIDE it.
 LOCK_RANKS = {
     "cluster.node": 10,       # cluster/node.py ClusterNode._lock (RLock)
+    # Gossip sits just above the node lock: _gossip_beat / _dht_sync run
+    # on the heartbeat and handler threads after releasing (or never
+    # taking) the node lock, but reconcile() is reachable from paths
+    # that held it — node (10) -> gossip (12) must be legal, the reverse
+    # never happens (membership.py takes no other lock).
+    "cluster.gossip": 12,     # cluster/dht/membership.py Gossip._lock
     "cluster.exec": 16,       # cluster/node.py _Exec.lock
     "obs.slo": 24,            # obs/slo.py SloMonitor._lock (RLock)
     # Between obs.slo and the serving coordination locks: the slo
@@ -618,6 +635,17 @@ LOCK_RANKS = {
     "frontdoor.cache": 46,    # serving/frontdoor/cache.py ResultCache._lock
     "frontdoor.race": 47,     # serving/portfolio.py race_native settle lock
     #   (winner claim only — never held into another acquisition)
+    # The DHT cache + ring locks rank ABOVE the front-door locks: the
+    # router's L2 lookup/store seam (FrontDoor.route -> ClusterCache)
+    # and the ring's owner_fn both run on front-door / device-loop
+    # threads that may hold frontdoor.router/cache — and NEVER the node
+    # lock (ClusterNode._ring_owner guards the ring with cluster.ring,
+    # not cluster.node, for exactly this reason).  Cache before ring:
+    # ClusterCache.lookup calls owner_fn BEFORE taking its own lock, so
+    # neither nests under the other today; the order leaves "consult the
+    # ring while holding the shard" legal if replication ever needs it.
+    "cluster.dhtcache": 48,   # cluster/dht/cluster_cache.py ClusterCache._lock
+    "cluster.ring": 49,       # cluster/node.py ClusterNode._ring_lock
     "native.build": 50,       # native/__init__.py _lock (libcsp build)
     "utils.profile_window": 52,  # utils/profiling.py _window_lock
     "obs.compilewatch": 60,   # obs/compilewatch.py CompileWatch._lock
@@ -737,6 +765,16 @@ DEADCK_BASE_CLASSES = {
     "ctrl": ("serving/brownout.py", "BrownoutController"),
     "self.ctrl": ("serving/brownout.py", "BrownoutController"),
     "bo": ("serving/brownout.py", "BrownoutController"),
+    "self.gossip": ("cluster/dht/membership.py", "Gossip"),
+    "g": ("cluster/dht/membership.py", "Gossip"),
+    "self.ring": ("cluster/dht/hashring.py", "HashRing"),
+    "self.dcache": ("cluster/dht/cluster_cache.py", "ClusterCache"),
+    "self.l2": ("cluster/node.py", "_L2Adapter"),
+    # SimNet._schedule is the fault plane, not the cluster cache: both
+    # carry a ``lookup`` method, and without the hint the edge pass's
+    # name-based over-approximation manufactures a phantom
+    # cluster.simnet -> cluster.dhtcache hold under the net condition.
+    "self._schedule": ("serving/faults.py", "FaultSchedule"),
 }
 
 # The repo's thread roots: qualname prefixes (per file) whose bodies run
@@ -757,7 +795,8 @@ DEADCK_THREAD_ROOTS = {
     "cluster/node.py": (
         "ClusterNode._hb_loop",
         "ClusterNode._progress_loop",
-        "ClusterNode._broadcast_network",
+        "ClusterNode._broadcast_send",   # beat-spawned view broadcasts
+        "ClusterNode._flush_parked",     # beat-spawned result re-offers
         "ClusterNode._handle",    # transport connection threads
         "ClusterNode.submit",     # client threads
         "_Exec._watch_local",
@@ -768,7 +807,11 @@ DEADCK_THREAD_ROOTS = {
         "fanout_requests",        # the per-peer ask() threads
     ),
     "cluster/simnet.py": (
-        "SimNet._deliver",        # virtual delivery threads
+        "SimNet._worker",          # pooled virtual delivery workers
+        "SimNet._overflow_worker", # nested-send escape hatch
+    ),
+    "cluster/dht/cluster_cache.py": (
+        "ClusterCache._put_loop",  # async CACHE_PUT retry daemon
     ),
     "serving/portfolio.py": (
         "race",                   # racer entrant threads (device/native)

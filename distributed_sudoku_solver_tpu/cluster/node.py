@@ -52,13 +52,17 @@ from typing import Callable, Optional
 import numpy as np
 
 from distributed_sudoku_solver_tpu.cluster import wire
+from distributed_sudoku_solver_tpu.cluster.dht import ClusterCache, Gossip, HashRing
 from distributed_sudoku_solver_tpu.cluster.wire import Addr, WireError, addr_str
 from distributed_sudoku_solver_tpu.models.geometry import geometry_for_size
 from distributed_sudoku_solver_tpu.obs import agg, lockdep, trace
 from distributed_sudoku_solver_tpu.obs.hist import LatencyHistogram
 from distributed_sudoku_solver_tpu.obs.logctx import ctx_log, job_log
+from distributed_sudoku_solver_tpu.serving import brownout as brownout_mod
 from distributed_sudoku_solver_tpu.serving import faults
 from distributed_sudoku_solver_tpu.serving.engine import Job, SolverEngine
+from distributed_sudoku_solver_tpu.serving.frontdoor import cache as fd_cache
+from distributed_sudoku_solver_tpu.serving.frontdoor import canonical as fd_canon
 
 # Diagnostics go through logging (stderr via the root handler / logging's
 # lastResort), not print(); failure-path messages carry the fault
@@ -138,6 +142,25 @@ class ClusterConfig:
     # peer; 0 disables (the failure detector covers actual deaths, and a
     # deep search can legitimately run long).
     part_deadline_s: float = 0.0
+    # The DHT plane (ISSUE 17, cluster/dht/): SWIM gossip liveness +
+    # consistent-hash ownership of the canonical digest space + the
+    # cluster-wide result cache.  The (term,epoch) view stays the
+    # membership authority; gossip adds O(1)-per-beat liveness (one PROBE
+    # with piggybacked state per beat) and the ring adds cache-affine
+    # routing.  ``dht=False`` restores the pre-DHT node exactly.
+    dht: bool = True
+    dht_vnodes: int = 32  # virtual points per member on the hash ring
+    dht_piggyback: int = 8  # max gossip updates per PROBE/ACK frame
+    dht_suspicion_s: float = 0.0  # 0 -> heartbeat_s * fail_factor
+    dht_probe_timeout_s: float = 0.0  # 0 -> min(stats_timeout_s, heartbeat_s)
+    dht_cache_entries: int = 65536  # per-node shard capacity
+    dht_get_timeout_s: float = 0.0  # 0 -> min(1.0, io_timeout_s)
+    # Cache-affine routing: submit() sends a cacheable board to its
+    # digest owner when the owner is gossip-ALIVE and not browning, so
+    # every orbit's repeats land where its entry lives.  Only engaged
+    # when this node runs a front door (no front door -> no cache to be
+    # affine to) — least-outstanding placement otherwise.
+    dht_affinity: bool = True
 
 
 class _DedupeLRU:
@@ -414,6 +437,52 @@ class _Exec:
         )
 
 
+class _L2Adapter:
+    """The duck-typed L2 the front door calls (router.py's ``self.l2``),
+    backed by the node's :class:`cluster.dht.ClusterCache`.
+
+    This is the ONE place the wire's JSON-ready entry dicts and the
+    front door's :class:`CacheEntry` meet: ``cluster/dht`` stays
+    stdlib-closed (no numpy, no serving import) and ``serving/frontdoor``
+    stays cluster-free — the conversion lives here, in the layer that
+    already imports both."""
+
+    def __init__(self, dcache: ClusterCache):
+        self.dcache = dcache
+
+    def lookup(self, digest: str, raw: str):
+        d = self.dcache.lookup(digest)
+        if d is None:
+            return None
+        verdict = d.get("verdict")
+        if verdict not in (fd_cache.SOLVED, fd_cache.UNSAT):
+            return None  # malformed wire entry: treat as a miss
+        sol = d.get("solution")
+        if verdict == fd_cache.SOLVED and sol is None:
+            return None
+        return fd_cache.CacheEntry(
+            verdict=verdict,
+            solution=None if sol is None else np.asarray(sol, dtype=np.int8),
+            nodes=int(d.get("nodes", 0)),
+            raw_digest=str(d.get("raw", raw)),
+            route=str(d.get("route", "cluster")),
+        )
+
+    def store(self, digest: str, entry) -> None:
+        self.dcache.store(
+            digest,
+            {
+                "verdict": entry.verdict,
+                "solution": None
+                if entry.solution is None
+                else np.asarray(entry.solution).tolist(),
+                "nodes": int(entry.nodes),
+                "raw": entry.raw_digest,
+                "route": entry.route,
+            },
+        )
+
+
 class ClusterNode:
     """One host in the solver cluster; wraps a local SolverEngine."""
 
@@ -506,6 +575,13 @@ class ClusterNode:
         self.partitions_healed = 0  # lockck: guard(_lock)
         self.demotions = 0  # lockck: guard(_lock)
         self.rehomed_parts = 0  # lockck: guard(_lock)
+        # Results whose at-least-once budget exhausted mid-partition wait
+        # here for the next beat's re-offer (_flush_parked): a partition
+        # longer than retries*delay degrades to a LATE delivery, not a
+        # lost result.
+        self._parked: list = []  # lockck: guard(_lock) — (peer, payload, first-try time)
+        self.results_parked = 0  # lockck: guard(_lock)
+        self.results_delivered_late = 0  # lockck: guard(_lock)
         # Cluster-scope observability (round 12, obs/): the node's own
         # mergeable wire-wall histograms (send = one egress through the
         # transport; ack = a result-bearing send's full at-least-once
@@ -513,6 +589,50 @@ class ClusterNode:
         # simnet lane's numbers are virtual and deterministic — plus the
         # METRICS_PULL aggregation counters exported as cluster.agg.
         self._hist = {"send_ms": LatencyHistogram(), "ack_ms": LatencyHistogram()}
+        # The DHT plane (ISSUE 17, cluster/dht/): gossip liveness, the
+        # consistent-hash ring over the canonical digest space, and this
+        # node's shard of the cluster-wide result cache.  The ring is
+        # guarded by its own high-ranked lock (NOT the node lock): owner
+        # lookups run on cache/front-door threads that may hold the
+        # frontdoor locks, which rank above cluster.node.
+        self.gossip: Optional[Gossip] = None
+        self.ring: Optional[HashRing] = None
+        self.dcache: Optional[ClusterCache] = None
+        self._ring_lock = lockdep.named_lock("cluster.ring")  # lockck: name(cluster.ring)
+        self.affinity_routed = 0  # lockck: guard(_lock) — submits sent to the digest owner
+        self.affinity_declined = 0  # lockck: guard(_lock) — owner unhealthy/browning; local fallback
+        if config.dht:
+            suspicion = config.dht_suspicion_s or (
+                config.heartbeat_s * config.fail_factor
+            )
+            self.gossip = Gossip(
+                self.addr_s,
+                self._clock.now,
+                suspicion_s=suspicion,
+                piggyback=config.dht_piggyback,
+            )
+            self.ring = HashRing(config.dht_vnodes)
+            self.ring.add(self.addr_s)
+            self.dcache = ClusterCache(
+                self.addr_s,
+                owner_fn=self._ring_owner,
+                request_fn=self._dht_request,
+                put_fn=self._dht_send,
+                clock=self._clock,
+                uuid_fn=lambda: str(uuid_mod.uuid4()),
+                capacity=config.dht_cache_entries,
+                get_timeout_s=config.dht_get_timeout_s
+                or min(1.0, config.io_timeout_s),
+                put_retries=config.send_retries,
+                retry_delay_s=config.retry_delay_s,
+            )
+            # Wire the front door's L2 seam (router.py self.l2): L1
+            # misses read through the cluster cache, fills replicate to
+            # the digest owner.  No front door -> no seam (the node's
+            # shard still serves CACHE_GET/CACHE_PUT for peers).
+            fd = getattr(engine, "frontdoor", None)
+            if fd is not None:
+                fd.l2 = _L2Adapter(self.dcache)
         self.agg_pulls = 0  # lockck: guard(_lock) — peer METRICS_PULL requests issued
         self.agg_merges = 0  # lockck: guard(_lock) — cluster rollups computed
         self.agg_unreachable = 0  # lockck: guard(_lock) — pulls that found a peer unreachable
@@ -618,9 +738,11 @@ class ClusterNode:
         so the receiver dedupes these methods by uuid (``_handle``); a
         lost-for-sure failure (connect refused/timed out) retries are what
         carry a result through a transient link fault at all.  Returns
-        False when every attempt failed: the peer is presumed dead and the
-        membership repair path (ledger re-execution, part re-homing) owns
-        the work from here."""
+        False when every attempt failed — the result is then PARKED and
+        re-offered once per beat (``_flush_parked``) until the link heals
+        or the origin stays gone past the tombstone horizon: a partition
+        that outlives the retry budget must degrade to a late delivery
+        (origin dedupes), never a lost result."""
         last: Optional[WireError] = None
         t0 = self._clock.now()
         for attempt in range(self.config.send_retries + 1):
@@ -637,14 +759,42 @@ class ClusterNode:
             except WireError as e:
                 last = e
         if not self._stop.is_set():
+            with self._lock:
+                self.results_parked += 1
+                self._parked.append((peer, payload, t0))
             _LOG.warning(
                 "[%s] %s to %s undeliverable after %d attempts "
-                "(uuid=%s): %r",
+                "(uuid=%s): %r — parked for per-beat re-delivery",
                 self.addr_s, payload.get("method"), peer,
                 self.config.send_retries + 1,
                 payload.get("uuid") or payload.get("part"), last,
             )
         return False
+
+    def _flush_parked(self) -> None:
+        """One re-delivery attempt per parked result (off the heartbeat
+        thread: a wedged TCP connect must not starve the failure
+        detector).  Items are swapped out under the lock so overlapping
+        flushes never double-send; still-failing items re-park; items
+        older than the tombstone horizon are dropped — by then the
+        origin's own repair (ledger re-execution) owns the job."""
+        now = self._clock.now()
+        with self._lock:
+            batch = self._parked
+            self._parked = []
+        keep = []
+        for peer, payload, t0 in batch:
+            if now - t0 > self.config.tombstone_probe_s:
+                continue
+            try:
+                self._send(peer, payload)
+                with self._lock:
+                    self.results_delivered_late += 1
+            except WireError:
+                keep.append((peer, payload, t0))
+        if keep:
+            with self._lock:
+                self._parked = keep + self._parked
 
     def _log_bad_message(self, e: BaseException) -> None:
         """Transport's handler-error sink: malformed or interrupted control
@@ -682,7 +832,9 @@ class ClusterNode:
             # when the view has shrunk to just us.
             if is_coord and (have_tombstones or len(self.network) > 1):
                 threading.Thread(
-                    target=self._broadcast_network, daemon=True
+                    target=self._broadcast_send,
+                    args=(self._broadcast_plan(),),
+                    daemon=True,
                 ).start()
             if orphaned:
                 # Evicted from the view (false death / lost partition) and
@@ -697,6 +849,16 @@ class ClusterNode:
             # that are no longer in the view at all.
             if self.config.part_deadline_s > 0:
                 self._recover_parts()
+            # Parked results (at-least-once budget exhausted mid-partition)
+            # get one re-offer per beat, off-thread.
+            with self._lock:
+                have_parked = bool(self._parked)
+            if have_parked:
+                threading.Thread(target=self._flush_parked, daemon=True).start()
+            # SWIM beat (runs even solo/orphaned: suspicion expiry must
+            # tick and a lone node's tick is a cheap no-probe).
+            if self.gossip is not None:
+                self._gossip_beat(term, epoch)
             pred, succ = self._ring()
             if succ is None:
                 with self._lock:
@@ -738,6 +900,11 @@ class ClusterNode:
         "SOLUTION": "uuid",
         "SUBTASK": "part",
         "PART_RESULT": "part",
+        # Cluster-cache fills are at-least-once (ClusterCache._put_loop
+        # retries with the same uuid); a redelivered PUT is idempotent
+        # anyway (deterministic solver), so the dedupe here exists to
+        # keep puts_applied/insertions honest, not for correctness.
+        "CACHE_PUT": "uuid",
     }
 
     @staticmethod
@@ -794,6 +961,15 @@ class ClusterNode:
             self._on_part_result(msg)
         elif method == "PROGRESS":
             self._on_progress(msg)
+        elif method == "PROBE":
+            return self._on_probe(msg)
+        elif method == "CACHE_GET":
+            if self.dcache is None:
+                return {"found": False, "entry": None}
+            return self.dcache.handle_get(msg)
+        elif method == "CACHE_PUT":
+            if self.dcache is not None:
+                self.dcache.handle_put(msg)
         elif method == "STATS_REQ":
             s = self.engine.stats()
             return {
@@ -864,7 +1040,13 @@ class ClusterNode:
             self._reflect_view(reflect_to)
 
     # -- membership ----------------------------------------------------------
-    def _broadcast_network(self) -> None:
+    def _broadcast_plan(self) -> tuple:
+        """Snapshot the view payload and target list NOW, in the caller's
+        thread.  The per-beat re-broadcast must carry the view as of the
+        beat: a split-brain loser demoted between spawning its sender
+        thread and the thread reading state would otherwise echo the
+        winner's view instead of offering its stale one for rejection —
+        the offer/reject/reflect exchange IS the heal channel."""
         now = self._clock.now()
         with self._lock:
             members = list(self.network)
@@ -889,12 +1071,18 @@ class ClusterNode:
             for m in expired:
                 del self._evicted[m]
             probes = [m for m in self._evicted if m not in members]
-        for m in members + probes:
-            if m != self.addr_s:
-                try:
-                    self._send(m, payload)
-                except WireError:
-                    pass  # its detector will notice soon enough
+        return payload, [m for m in members + probes if m != self.addr_s]
+
+    def _broadcast_send(self, plan: tuple) -> None:
+        payload, targets = plan
+        for m in targets:
+            try:
+                self._send(m, payload)
+            except WireError:
+                pass  # its detector will notice soon enough
+
+    def _broadcast_network(self) -> None:
+        self._broadcast_send(self._broadcast_plan())
 
     def _on_join_req(self, joiner: str) -> None:
         if self.coordinator != self.addr_s:
@@ -916,6 +1104,7 @@ class ClusterNode:
             # per-beat view re-broadcast covers a joiner that missed ours.
             self._count_duplicate("JOIN_REQ")
             return
+        self._dht_sync()
         self._broadcast_network()
 
     def _on_update_network(self, msg: dict) -> None:
@@ -930,6 +1119,8 @@ class ClusterNode:
         sender = msg.get("from")
         rejoin = False
         reflect_to = None
+        concede_to = None
+        concede_payload = None
         gone: list = []
         with self._lock:
             if (term, epoch) <= (self.net_term, self.net_epoch):
@@ -970,6 +1161,24 @@ class ClusterNode:
                         self.addr_s, term, epoch, coordinator,
                         self.net_term, self.net_epoch,
                     )
+                    # Concession: announce the superseded view to the
+                    # winner as the last act of this coordinatorship.  The
+                    # winner rejects it as stale, which leaves a durable
+                    # record of the rivalry in ITS fault counters no matter
+                    # which heal channel fired first (its tombstone probe
+                    # teaching us, or our stale offer being reflected) —
+                    # without this, a probe-first heal ends with neither
+                    # side's stale_views_rejected showing a split-brain
+                    # ever happened.
+                    concede_to = coordinator
+                    concede_payload = {
+                        "method": "UPDATE_NETWORK",
+                        "network": list(self.network),
+                        "coordinator": self.coordinator,
+                        "term": self.net_term,
+                        "epoch": self.net_epoch,
+                        "from": self.addr_s,
+                    }
                     self._evicted.clear()  # no longer the membership authority
                 self.network = network
                 self.coordinator = coordinator
@@ -991,6 +1200,12 @@ class ClusterNode:
         if reflect_to:
             self._reflect_view(reflect_to)
             return
+        if concede_to is not None:
+            try:
+                self._send(concede_to, concede_payload)
+            except WireError:
+                pass  # observability-only: the demotion itself is done
+        self._dht_sync()
         for u in gone:
             self._reexecute(u)
         self._recover_parts()
@@ -1068,6 +1283,7 @@ class ClusterNode:
                     for u, e in self._ledger.items()
                     if e["member"] not in self.network
                 ]
+            self._dht_sync()
             self._broadcast_network()
             for u in gone:
                 self._reexecute(u)
@@ -1104,6 +1320,163 @@ class ClusterNode:
                 self.net_term += 1
             self._last_hb = self._clock.now()
         self._on_node_failed(dead)
+
+    # -- the DHT plane (ISSUE 17: cluster/dht/) ------------------------------
+    def _dht_sync(self) -> None:
+        """Reconcile gossip + ring with the authoritative (term,epoch)
+        view.  Called after every installed membership change; the view
+        advance doubles as the refutation channel for restarted members
+        whose incarnation reset (membership.py reconcile note)."""
+        if self.gossip is None:
+            return
+        with self._lock:
+            members = list(self.network)
+        self.gossip.reconcile(members)
+        with self._ring_lock:
+            want = set(members) | {self.addr_s}
+            for m in self.ring.members():
+                if m not in want:
+                    self.ring.remove(m)
+            for m in want:
+                if m not in self.ring:
+                    self.ring.add(m)
+
+    def _ring_owner(self, digest: str) -> Optional[str]:
+        """The cluster cache's owner_fn.  Runs on submit / device-loop /
+        front-door threads — guarded by the ring's own high-ranked lock,
+        never the node lock (frontdoor locks rank above cluster.node)."""
+        if self.ring is None:
+            return None
+        with self._ring_lock:
+            return self.ring.owner(digest)
+
+    def _dht_request(self, peer: str, frame: dict, timeout: float) -> dict:
+        """CACHE_GET request/reply (short deadline; a WireError is just
+        a cache miss to the caller)."""
+        return self._transport.request(wire.parse_addr(peer), frame, timeout)
+
+    def _dht_send(self, peer: str, frame: dict) -> None:
+        """CACHE_PUT egress: through the node's one egress seam so the
+        fault plane, trace spans, and send-wall histogram all see it."""
+        self._send(peer, frame)
+
+    def _gossip_beat(self, term: int, epoch: int) -> None:
+        """One SWIM beat: expire suspicions, probe one member with
+        piggybacked state, merge the ack's piggyback.  O(1) traffic per
+        beat regardless of ring size — the whole point."""
+        g = self.gossip
+        if g is None:
+            return
+        ctrl = brownout_mod.active()
+        if ctrl is not None:
+            # Self-report brownout on the piggyback: browning owners
+            # decline cache-affine forwards at the REQUESTER, wire-free.
+            g.set_brown(ctrl.stage() > 0)
+        target, newly_dead = g.tick()
+        for m in newly_dead:
+            # Suspicion expired unrefuted: feed the existing eviction
+            # machinery (coordinator evicts + tombstones; a non-
+            # coordinator forwards NODE_FAILED with its term).
+            self._on_node_failed(m)
+        if target is None:
+            return
+        timeout = self.config.dht_probe_timeout_s or min(
+            self.config.stats_timeout_s, self.config.heartbeat_s
+        )
+        payload = {
+            "method": "PROBE",
+            "from": self.addr_s,
+            "term": term,
+            "epoch": epoch,
+            "updates": g.updates(),
+        }
+        try:
+            reply = self._transport.request(
+                wire.parse_addr(target), payload, timeout
+            )
+        except WireError:
+            g.on_probe_fail(target)
+            return
+        g.on_ack(target)
+        if isinstance(reply, dict):
+            ups = reply.get("updates")
+            if isinstance(ups, list):
+                g.merge(ups)
+
+    def _on_probe(self, msg: dict) -> dict:
+        """PROBE handler: merge the sender's piggyback, answer with ours.
+        (term,epoch)-guarded like HEARTBEAT — a probe asserting a stale
+        term gets the view reflected back (rate-limited) instead of its
+        gossip being trusted."""
+        g = self.gossip
+        if g is None:
+            return {"method": "PROBE_ACK", "from": self.addr_s, "updates": []}
+        term = msg.get("term")
+        sender = msg.get("from")
+        reflect_to = None
+        with self._lock:
+            if term is not None and int(term) < self.net_term:
+                self.stale_views_rejected += 1
+                if isinstance(sender, str) and ":" in sender:
+                    reflect_to = self._reflect_ok_locked(sender)
+        if reflect_to:
+            self._reflect_view(reflect_to)
+        elif isinstance(msg.get("updates"), list):
+            g.merge(msg["updates"])
+        return {"method": "PROBE_ACK", "from": self.addr_s, "updates": g.updates()}
+
+    def _affinity_owner(self, g: np.ndarray) -> Optional[str]:
+        """Cache-affine placement for submit(): the digest owner when it
+        is gossip-healthy (ALIVE, not browning), else None (fall back to
+        least-outstanding).  Only consulted when this node runs a front
+        door — without one there is no cache to be affine to."""
+        try:
+            geom = geometry_for_size(g.shape[0])
+            cf = fd_canon.canonicalize(g, geom)
+        except Exception:
+            return None  # malformed / uncanonicalizable: ordinary path
+        if cf is None:
+            return None
+        owner = self._ring_owner(cf.digest)
+        if owner is None:
+            return None
+        if owner != self.addr_s and (
+            self.gossip is None or not self.gossip.is_healthy(owner)
+        ):
+            with self._lock:
+                self.affinity_declined += 1
+            return None
+        with self._lock:
+            self.affinity_routed += 1
+        return owner
+
+    def dht_view(self, owner_of: Optional[str] = None) -> dict:
+        """``GET /network?scope=dht``: the gossip view (states,
+        incarnations, brownout flags), ring ownership summary, and this
+        node's shard counters; ``owner_of`` adds a digest's owner and
+        replica set."""
+        with self._lock:
+            coord = self.coordinator
+            view = [self.net_term, self.net_epoch]
+        with self._ring_lock:
+            ring = self.ring.summary()
+            owner = self.ring.owner(owner_of) if owner_of else None
+            replicas = self.ring.replicas(owner_of, 2) if owner_of else None
+        out = {
+            "address": self.addr_s,
+            "coordinator": coord,
+            "view": view,
+            "members": self.gossip.view(),
+            "ring": ring,
+            "cluster_cache": self.dcache.metrics(),
+        }
+        if owner_of:
+            out["owner"] = {
+                "digest": owner_of,
+                "owner": owner,
+                "replicas": replicas,
+            }
+        return out
 
     # -- local execution (engine + shed parts) -------------------------------
     def _start_exec(
@@ -1186,7 +1559,20 @@ class ClusterNode:
         g = np.asarray(grid, dtype=np.int32)
         if g.ndim != 2 or g.shape[0] != g.shape[1]:
             raise ValueError(f"grid must be square, got {g.shape}")
-        member = self._pick_member()
+        member = None
+        if (
+            self.dcache is not None
+            and self.config.dht_affinity
+            and getattr(self.engine, "frontdoor", None) is not None
+        ):
+            # Cache-affine routing (ISSUE 17): a cacheable board goes to
+            # its canonical digest's ring owner — where the cluster-cache
+            # entry lives or will live — when that owner is gossip-ALIVE
+            # and not browning.  Unhealthy/browning owner: the requester
+            # keeps the job (brownout-aware decline, solved locally).
+            member = self._affinity_owner(g)
+        if member is None:
+            member = self._pick_member()
         if member == self.addr_s:
             # Client-facing dispatch: a saturated local resident flight
             # rejects (EngineSaturated -> HTTP 429 + Retry-After) instead
@@ -1796,6 +2182,9 @@ class ClusterNode:
                 # demotions — rival coordinators that stood down (loser
                 # side); rehomed_parts — shed parts re-entered locally
                 # after executor death/deadline.
+                # results_parked / results_delivered_late — result sends
+                # whose at-least-once budget exhausted mid-partition,
+                # parked and re-offered per beat until the link healed.
                 "faults": {
                     "duplicates_dropped": dict(self.duplicates_dropped),
                     "stale_views_rejected": self.stale_views_rejected,
@@ -1803,6 +2192,8 @@ class ClusterNode:
                     "partitions_healed": self.partitions_healed,
                     "demotions": self.demotions,
                     "rehomed_parts": self.rehomed_parts,
+                    "results_parked": self.results_parked,
+                    "results_delivered_late": self.results_delivered_late,
                 },
                 # Cluster-scope aggregation health (round 12): pulls =
                 # peer METRICS_PULL requests issued, merges = rollups
@@ -1814,9 +2205,30 @@ class ClusterNode:
                     "unreachable_peers": self.agg_unreachable,
                 },
             }
+        if self.gossip is not None:
+            # The DHT plane (ISSUE 17): gossip liveness counters, ring
+            # shape, the node's cluster-cache shard, and cache-affine
+            # routing decisions.  Rolled up by obs/agg._merge_dht and
+            # rendered as dsst_dht_* prometheus families.
+            with self._ring_lock:
+                ring_members = len(self.ring)
+            with self._lock:
+                affinity = {
+                    "routed": self.affinity_routed,
+                    "declined": self.affinity_declined,
+                }
+            body["dht"] = {
+                "gossip": self.gossip.metrics(),
+                "ring": {
+                    "members": ring_members,
+                    "vnodes": self.config.dht_vnodes,
+                },
+                "cluster_cache": self.dcache.metrics(),
+                "affinity": affinity,
+            }
         return body
 
-    def cluster_metrics_view(self) -> dict:
+    def cluster_metrics_view(self, sample: int = 0) -> dict:
         """``GET /metrics?scope=cluster``: fan a METRICS_PULL over the
         current view (bounded, per-peer ``stats_timeout_s`` deadlines —
         the handler thread never hangs on a partitioned member) and merge
@@ -1828,11 +2240,22 @@ class ClusterNode:
         whose (term, epoch) disagrees with ours is flagged ``stale`` —
         its numbers still merge (they are real samples), but the reader
         knows the membership pictures differ.  Any member can serve
-        this; the fan-out runs over the caller's own view."""
+        this; the fan-out runs over the caller's own view.
+
+        ``sample`` > 0 caps the pull at that many peers for large rings
+        (``GET /metrics?scope=cluster&sample=N``): an evenly spaced,
+        DETERMINISTIC subset — no RNG, so repeated scrapes and the
+        simnet lane pull the same members — with the rollup flagged
+        ``sampled`` and ``members_total`` carrying the true ring size."""
         with self._lock:
             peers = [m for m in self.network if m != self.addr_s]
             view = (self.net_term, self.net_epoch)
             coordinator = self.coordinator
+        members_total = len(peers) + 1
+        sampled = bool(sample) and len(peers) > sample
+        if sampled:
+            stride = len(peers) / sample
+            peers = [peers[int(i * stride)] for i in range(sample)]
         payload = {
             "method": "METRICS_PULL",
             "from": self.addr_s,
@@ -1884,6 +2307,8 @@ class ClusterNode:
         )
         rollup["nodes"] = len(nodes)
         rollup["unreachable"] = unreachable
+        rollup["members_total"] = members_total
+        rollup["sampled"] = sampled
         return {
             "scope": "cluster",
             "address": self.addr_s,

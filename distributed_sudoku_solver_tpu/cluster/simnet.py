@@ -50,6 +50,7 @@ import random
 import threading
 import time as _time  # real time ONLY for bounded settling waits, never slept on
 import zlib
+from collections import deque
 from typing import Callable, Iterable, Optional, Union
 
 from distributed_sudoku_solver_tpu.cluster.wire import (
@@ -73,6 +74,19 @@ _REAL_WAIT_CAP_S = 60.0
 # beat's work is sub-millisecond; the grace only delays settle() once per
 # killed node.
 _BETWEEN_GRACE_S = 0.25
+
+# Delivery worker pool size.  Deliveries used to spawn one ephemeral
+# thread each, which is fine for a 5-node ring and catastrophic for a
+# 500-node soak (every advance() step forked hundreds of threads and the
+# interpreter spent its time in thread setup/teardown, not handlers).  A
+# small pool of persistent daemon workers drains the same queue with the
+# same semantics.  The pool is safe because of a contract the repo's
+# handlers already obey: a wire handler NEVER blocks on virtual time (it
+# computes a reply and returns; slow work — solving, result send retries
+# — happens on node/engine threads).  A handler that virtually slept
+# would have broken settle() under the old design too (its delivery
+# counted in ``_active`` until return).
+_POOL_WORKERS = 16
 
 _AddrLike = Union[Addr, str]
 
@@ -187,7 +201,11 @@ class SimNet:
         # belongs to a thread that exited its loop (node stopped) and is
         # purged.
         self._between: dict = {}  # thread ident -> real wake time
-        self._active = 0  # in-flight delivery threads
+        self._active = 0  # deliveries enqueued or in a handler
+        self._work: deque = deque()  # due deliveries awaiting a worker
+        self._workers_started = 0
+        self._idle_workers = 0
+        self._worker_idents: set = set()  # pool + overflow thread idents
         self._next_port = 7000
         self.clock = SimClock(self)
         # Observability for tests: what the network actually did.
@@ -227,38 +245,38 @@ class SimNet:
         deliveries, then (bounded, real) wait for the woken threads to get
         a scheduling slice so their reactions land before the caller's
         next predicate check."""
-        due = []
         with self._cond:
             self._now += dt
             while self._queue and self._queue[0][0] <= self._now:
-                due.append(heapq.heappop(self._queue))
+                self._enqueue_locked(heapq.heappop(self._queue))
             self._cond.notify_all()
             # Hand the CPU to woken sleepers (heartbeat loops): each
-            # removes its entry on the way out of sleep().
-            real_deadline = _monotonic() + 2.0
+            # removes its entry on the way out of sleep().  The real
+            # deadline scales mildly with population — 500 heartbeat
+            # loops legitimately need more slices than 5.
+            real_deadline = _monotonic() + max(2.0, 0.01 * len(self._sleepers))
             while any(d <= self._now for d in self._sleepers.values()):
                 if _monotonic() >= real_deadline:
                     break
                 self._cond.wait(0.005)
-        for item in due:
-            self._spawn(item)
         if settle:
             self.settle()
 
-    def settle(self, real_timeout: float = 10.0) -> bool:
+    def settle(self, real_timeout: Optional[float] = None) -> bool:
         """Wait (real, bounded) until every due delivery has been handed to
         its handler, the handler returned, and every woken sleeper (a
         heartbeat loop mid-beat) has re-entered its sleep — the yield point
         between a virtual step and the next predicate check."""
+        if real_timeout is None:
+            # Scales with population: a 500-node beat's probe fan has far
+            # more deliveries to drain through the pool than a 3-node ring.
+            with self._cond:
+                real_timeout = max(10.0, 0.05 * len(self._handlers))
         deadline = _monotonic() + real_timeout
         with self._cond:
             while True:
                 while self._queue and self._queue[0][0] <= self._now:
-                    item = heapq.heappop(self._queue)
-                    self._active += 1
-                    threading.Thread(
-                        target=self._deliver, args=(item,), daemon=True
-                    ).start()
+                    self._enqueue_locked(heapq.heappop(self._queue))
                 now_r = _monotonic()
                 for tid in [
                     t
@@ -359,7 +377,6 @@ class SimNet:
         payload = json.dumps(msg)
         if len(payload) > MAX_FRAME:
             raise WireError(f"frame too large: {len(payload)} bytes")
-        immediate = []
         with self._cond:
             if self._closed:
                 raise WireError(f"connect to {dst_s} failed: simnet closed")
@@ -393,12 +410,7 @@ class SimNet:
                 if at > now:
                     heapq.heappush(self._queue, item)
                 else:
-                    self._active += 1
-                    immediate.append(item)
-        for item in immediate:
-            threading.Thread(
-                target=self._deliver, args=(item,), daemon=True, name="sim-deliver"
-            ).start()
+                    self._enqueue_locked(item)
         if kind == "drop":
             # The sender's view of a frame lost after connect: ambiguous —
             # its retry (if any) is honest at-least-once re-dispatch.
@@ -408,12 +420,66 @@ class SimNet:
                 ambiguous_delivery=True,
             )
 
-    def _spawn(self, item) -> None:
+    def _enqueue_locked(self, item) -> None:
+        # Caller holds self._cond.  Hands a due delivery to the worker
+        # pool, growing it (up to the cap) when the backlog outruns the
+        # idle workers.
+        self._active += 1
+        self._work.append(item)
+        if self._idle_workers < len(self._work):
+            if self._workers_started < _POOL_WORKERS:
+                self._workers_started += 1
+                threading.Thread(
+                    target=self._worker,
+                    daemon=True,
+                    name=f"sim-worker-{self._workers_started}",
+                ).start()
+            elif (
+                self._idle_workers == 0
+                and threading.get_ident() in self._worker_idents
+            ):
+                # A handler running ON the last free worker just issued a
+                # nested send/request (e.g. a node forwarding during
+                # dispatch).  With every worker occupied that delivery
+                # could starve the pool the handler is waiting on, so a
+                # transient overflow worker drains until the backlog dries.
+                threading.Thread(
+                    target=self._overflow_worker, daemon=True,
+                    name="sim-overflow",
+                ).start()
+        self._cond.notify_all()
+
+    def _worker(self) -> None:
+        # Persistent delivery worker: drains self._work, calling each
+        # handler OUTSIDE the condition (same invariant the per-delivery
+        # threads had).  Exits when the net closes and the backlog is dry.
+        self._register_worker()
+        while True:
+            with self._cond:
+                self._idle_workers += 1
+                try:
+                    while not self._work and not self._closed:
+                        self._cond.wait(_REAL_WAIT_CAP_S)
+                    if not self._work:
+                        return  # closed and dry
+                    item = self._work.popleft()
+                finally:
+                    self._idle_workers -= 1
+            self._deliver(item)
+
+    def _overflow_worker(self) -> None:
+        self._register_worker()
+        while True:
+            with self._cond:
+                if not self._work:
+                    self._worker_idents.discard(threading.get_ident())
+                    return
+                item = self._work.popleft()
+            self._deliver(item)
+
+    def _register_worker(self) -> None:
         with self._cond:
-            self._active += 1
-        threading.Thread(
-            target=self._deliver, args=(item,), daemon=True, name="sim-deliver"
-        ).start()
+            self._worker_idents.add(threading.get_ident())
 
     def _deliver(self, item) -> None:
         _at, _seq, dst_s, payload, reply = item

@@ -32,6 +32,7 @@ This module also hosts the *production* transport/clock pair behind
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import socket
@@ -123,18 +124,28 @@ def send_msg(addr: Addr, msg: dict, timeout: float = 5.0) -> None:
         ) from e
 
 
-def fanout_requests(transport, peers, payload: dict, timeout: float) -> list:
+def fanout_requests(
+    transport, peers, payload: dict, timeout: float, max_threads: int = 32
+) -> list:
     """Parallel request/reply fan-out with per-peer timeouts — the shape
     ``stats_view`` always used, now shared with the cluster metrics pull
     (METRICS_PULL, ``GET /metrics?scope=cluster``).
 
-    One daemon thread per peer, each bounded by ``timeout``; a peer that
-    fails, is partitioned, or answers late yields ``None`` in its slot.
-    The caller's wall time is bounded by ~``timeout`` + join slack, never
-    O(peers) serial timeouts — which is what keeps the aggregation
-    endpoints from ever hanging an HTTP handler thread on a degraded
-    ring.  ``peers`` are addr strings or parsed ``Addr`` tuples."""
+    At most ``max_threads`` daemon worker threads drain the peer list,
+    each request bounded by ``timeout``; a peer that fails, is
+    partitioned, or answers late yields ``None`` in its slot.  The
+    bounded pool is what keeps a 500-member pull from forking 500
+    threads per scrape (ISSUE 17 satellite); wall time is bounded by
+    ~``ceil(peers/max_threads) * timeout`` worst-case but in practice by
+    the slowest stragglers, never O(peers) serial timeouts — which is
+    what keeps the aggregation endpoints from ever hanging an HTTP
+    handler thread on a degraded ring.  ``peers`` are addr strings or
+    parsed ``Addr`` tuples."""
     results: list = [None] * len(peers)
+    if not peers:
+        return results
+    nworkers = max(1, min(int(max_threads), len(peers)))
+    cursor = itertools.count()
 
     def ask(i: int, peer) -> None:
         addr = peer if isinstance(peer, tuple) else parse_addr(peer)
@@ -143,14 +154,23 @@ def fanout_requests(transport, peers, payload: dict, timeout: float) -> list:
         except WireError:
             pass  # slot stays None: the caller flags the peer
 
+    def drain() -> None:
+        for i in cursor:
+            if i >= len(peers):
+                return
+            ask(i, peers[i])
+
     threads = [
-        threading.Thread(target=ask, args=(i, m), daemon=True)
-        for i, m in enumerate(peers)
+        threading.Thread(target=drain, daemon=True) for _ in range(nworkers)
     ]
     for t in threads:
         t.start()
+    # Each worker serves ~peers/nworkers requests back to back; the join
+    # budget covers that plus slack, so a wedged transport still cannot
+    # hang the caller.
+    budget = timeout * (len(peers) / nworkers + 1.0) + 1.0
     for t in threads:
-        t.join(timeout + 1.0)
+        t.join(budget)
     # Snapshot: a straggler thread finishing after its join timeout must
     # not mutate what the caller is already iterating.
     return list(results)

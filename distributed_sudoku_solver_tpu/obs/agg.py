@@ -115,6 +115,52 @@ def _merge_brownout(acc: dict, sec: dict) -> None:
             cur[i] = round(cur[i] + v, 3)
 
 
+#: Gossip EVENT counters that sum soundly across members.  The state
+#: gauges (alive/suspect/dead/members, self incarnation) are per-node
+#: truth — every member counts the whole ring, so summing them would
+#: multiply the answer by the membership; they stay in the per-node
+#: breakdown.
+_DHT_GOSSIP_SUM = (
+    "refutations",
+    "suspicions",
+    "deaths",
+    "resurrections",
+    "stale_ignored",
+    "merged",
+)
+
+
+def _merge_dht(acc: dict, sec: dict) -> None:
+    """Sum one member's ``dht`` section (cluster/dht/): gossip event
+    counters, cluster-cache shard counters (summing ``entries`` across
+    shards IS the cluster cache size — shards are disjoint by ring
+    ownership; ``capacity`` is per-node policy and is deliberately NOT
+    merged), and cache-affine routing decisions."""
+    gossip = sec.get("gossip")
+    if isinstance(gossip, dict):
+        slot = acc.setdefault("gossip", {})
+        for f in _DHT_GOSSIP_SUM:
+            v = gossip.get(f)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                slot[f] = slot.get(f, 0) + v
+    cache = sec.get("cluster_cache")
+    if isinstance(cache, dict):
+        slot = acc.setdefault("cluster_cache", {})
+        for f in sorted(cache, key=str):
+            if f == "capacity":
+                continue
+            v = cache[f]
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                slot[str(f)] = slot.get(str(f), 0) + v
+    aff = sec.get("affinity")
+    if isinstance(aff, dict):
+        slot = acc.setdefault("affinity", {})
+        for f in ("routed", "declined"):
+            v = aff.get(f)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                slot[f] = slot.get(f, 0) + v
+
+
 def _merge_critpath(acc: dict, sec: dict) -> None:
     """Sum one member's ``critpath`` section: jobs + per-phase
     attribution totals (ms sums merge soundly; shares are re-derived
@@ -141,6 +187,7 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
     compile_acc: dict = {}
     critpath_acc: dict = {}
     brownout_acc: dict = {}
+    dht_acc: dict = {}
     for body in bodies:
         if not isinstance(body, dict):
             continue
@@ -162,6 +209,8 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
             _merge_critpath(critpath_acc, body["critpath"])
         if isinstance(body.get("brownout"), dict):
             _merge_brownout(brownout_acc, body["brownout"])
+        if isinstance(body.get("dht"), dict):
+            _merge_dht(dht_acc, body["dht"])
     quantiles = {}
     for k, h in hists.items():
         n = hist_mod.hist_count(h)
@@ -181,6 +230,8 @@ def rollup(bodies: Iterable[Optional[dict]]) -> dict:
         out["compile"] = compile_acc
     if brownout_acc:
         out["brownout"] = brownout_acc
+    if dht_acc:
+        out["dht"] = dht_acc
     if critpath_acc:
         total = sum(
             v for v in critpath_acc.get("attribution_ms", {}).values()

@@ -1154,16 +1154,20 @@ class SolverEngine:
             else:
                 for job in jobs:
                     job.error = label
-                    job.done.set()
                     self.fault_permanent += 1
                     job_log(_LOG, job.uuid).error(
                         "[engine] permanent failure: %s", label
                     )
+                    # Span BEFORE the done event (_finish_job's contract):
+                    # setting done releases the cluster waiter that ships
+                    # the SOLUTION, and a reader stitching the trace at
+                    # resolve time must already see the fault.
                     if rec is not None:
                         rec.event(
                             job.uuid, "fault.permanent", "engine.recovery",
                             node=self.trace_node, error=label,
                         )
+                    job.done.set()
                 if rec is not None:
                     # The flight-recorder moment: an isolated permanent
                     # fault just failed a paying job — dump the recent
@@ -1927,6 +1931,21 @@ class SolverEngine:
                 cp = critpath.active()
                 if cp is not None:
                     cp.observe_job(job.uuid, wall)
+            # Same hook contract as _finish_job: verdict fields set, fired
+            # at most once, BEFORE the done event (a waiter that resubmits
+            # immediately must see the front-door cache fill), and never
+            # allowed to kill resolution.  Without this, solve_fn engines
+            # (the whole simnet/oracle lane) silently skip every
+            # device-route cache fill the flight path performs.
+            cb = job.on_resolve
+            if cb is not None:
+                job.on_resolve = None
+                try:
+                    cb(job)
+                except Exception:  # noqa: BLE001
+                    _LOG.exception(
+                        "[engine] on_resolve hook failed for %s", job.uuid
+                    )
             job.done.set()
         self.batch_sizes.record(float(len(group)))
         with self._lock:  # shared with megastep-thread resolutions since round 19

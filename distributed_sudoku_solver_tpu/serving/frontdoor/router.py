@@ -156,6 +156,12 @@ class FrontDoor:
         self.engine = engine
         self.config = config or FrontDoorConfig()
         self.cache = cache_mod.ResultCache(self.config.cache_entries)
+        # Cluster-wide cache seam (ISSUE 17): an injected second level
+        # behind the local LRU.  Duck-typed — ``lookup(digest, raw) ->
+        # Optional[CacheEntry]`` and ``store(digest, entry)`` — so this
+        # layer never imports cluster; ``cluster/node.py`` installs its
+        # adapter at start().  None = single-node, zero behavior change.
+        self.l2 = None
         self._lock = lockdep.named_lock("frontdoor.router")  # lockck: name(frontdoor.router)
         self.route_counts = {  # lockck: guard(_lock)
             "cache": 0, "propagation": 0, "native": 0, "device": 0,
@@ -164,6 +170,7 @@ class FrontDoor:
             "solved": 0, "unsat": 0, "easy": 0, "hard": 0,
         }
         self.uncacheable = 0  # lockck: guard(_lock) — boards with no canonical form
+        self.cluster_hits = 0  # lockck: guard(_lock) — L1 misses answered by the L2 seam
         self.native_fallback_wins = 0  # lockck: guard(_lock) — device fallback beat the native racer
         self.answered = 0  # lockck: guard(_lock) — jobs resolved by the front door itself
         self.answered_solved = 0  # lockck: guard(_lock)
@@ -218,6 +225,16 @@ class FrontDoor:
                 self.uncacheable += 1
         else:
             entry = self.cache.lookup_entry(cf.digest, raw)
+            if entry is None and self.l2 is not None:
+                # L1 miss -> ask the cluster cache (digest owner).  Any
+                # wire trouble is just a miss.  A hit is promoted into
+                # the local LRU (read-through) so the next job in this
+                # orbit answers wire-free.
+                entry = self.l2.lookup(cf.digest, raw)
+                if entry is not None:
+                    self.cache.store_entry(cf.digest, entry)
+                    with self._lock:
+                        self.cluster_hits += 1
         if rec is not None:
             rec.record(
                 job.uuid, "cache.lookup", "frontdoor.cache", t0,
@@ -438,6 +455,11 @@ class FrontDoor:
         else:
             return
         self.cache.store_entry(cf.digest, entry)
+        if self.l2 is not None:
+            # Async on the adapter's side for remote owners: the filling
+            # thread is often the device loop, which must never wait on
+            # the wire.
+            self.l2.store(cf.digest, entry)
 
     # -- plumbing ------------------------------------------------------------
     @staticmethod
@@ -463,6 +485,7 @@ class FrontDoor:
                 "routes": dict(self.route_counts),
                 "probe": dict(self.probe_counts),
                 "uncacheable": int(self.uncacheable),
+                "cluster_hits": int(self.cluster_hits),
                 "native_available": bool(self.native_available),
                 "native_fallback_wins": int(self.native_fallback_wins),
                 "pending_fills": len(self._pending),

@@ -101,9 +101,10 @@ Differences are deliberate upgrades, not behavior drift:
 
 Observability endpoints (rounds 11-12, ``obs/``) — the full endpoint set
 served here is: ``POST /solve``, ``POST /solve_batch``, ``POST
-/profile``, ``GET /stats``, ``GET /network``, ``GET /metrics``
-(``?format=prometheus``, ``?scope=cluster``), ``GET /trace[/uuid]``
-(``?format=perfetto``), ``GET /status``, ``GET /slo``:
+/profile``, ``GET /stats``, ``GET /network`` (``?scope=dht``), ``GET
+/metrics`` (``?format=prometheus``, ``?scope=cluster``, ``&sample=N``),
+``GET /trace[/uuid]`` (``?format=perfetto``), ``GET /status``, ``GET
+/slo``:
 
 * ``GET /trace`` — recent flight-recorder spans (JSON);
   ``?format=perfetto`` exports the ring as Chrome-trace JSON (open in
@@ -119,7 +120,16 @@ served here is: ``POST /solve``, ``POST /solve_batch``, ``POST
   into Prometheus text exposition (``obs/prom.py``); with
   ``scope=cluster`` the federated form: the merged rollup plus per-node
   reachability gauges.
-* ``GET /metrics?scope=cluster`` — the cluster-scope merge (see above).
+* ``GET /metrics?scope=cluster`` — the cluster-scope merge (see above);
+  ``&sample=N`` bounds the fan-out to a deterministic stride sample of N
+  members (the O(1)-per-scrape mode for large rings).
+* ``GET /network?scope=dht`` — the DHT plane (round 20,
+  ``cluster/dht/``): gossip membership view (per-member state /
+  incarnation / brownout flag), consistent-hash ring summary, and this
+  node's cluster-cache shard counters; ``&owner=<digest-hex>`` resolves
+  a canonical digest to its owner and replica set.  Structured 400 on an
+  unknown scope or malformed digest, 404 when the DHT plane is off —
+  the bare ``GET /network`` ring shape is API-pinned and unchanged.
 * ``GET /status`` — compact health: member reachability/staleness,
   cluster latency quantiles from the merged histograms, the
   ``rpc_floor_ms`` estimate, and the SLO plane's state (``obs/agg.py``).
@@ -585,7 +595,7 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/stats":
             return self._send(200, node.stats_view())
         if path == "/network":
-            return self._send(200, node.network_view())
+            return self._network_view(node, query)
         if path == "/metrics":
             # Superset endpoint (not in the reference): per-node latency
             # percentiles, batch sizes, device info — SURVEY.md §5.5.
@@ -622,13 +632,52 @@ class _Handler(BaseHTTPRequestHandler):
             return self._trace_view(path, query)
         return self._send(404, {"error": "not found"})
 
+    def _network_view(self, node, query: dict):
+        """``GET /network`` — the pinned ring-view shape; ``?scope=dht``
+        adds the gossip membership view, consistent-hash ring summary,
+        and this node's cluster-cache shard counters (``cluster/dht``),
+        with ``&owner=<digest-hex>`` resolving a canonical digest to its
+        owner and replica set.  Hardened like ``/trace``: an unknown
+        scope or malformed owner digest is a structured 400, a node
+        running without the DHT plane answers a structured 404 — never a
+        500 (API-pinned)."""
+        scope = query.get("scope", [""])[0]
+        if scope in ("", "ring"):
+            return self._send(200, node.network_view())
+        if scope != "dht":
+            return self._send(
+                400,
+                {"error": f"scope must be 'dht', got {scope!r}"},
+            )
+        if getattr(node, "gossip", None) is None:
+            return self._send(
+                404,
+                {
+                    "error": "DHT disabled (single node, or started with"
+                    " dht=False)"
+                },
+            )
+        owner_of = query.get("owner", [""])[0] or None
+        if owner_of is not None:
+            try:
+                int(owner_of, 16)
+            except ValueError:
+                return self._send(
+                    400,
+                    {
+                        "error": "owner must be a hex canonical digest,"
+                        f" got {owner_of!r}"
+                    },
+                )
+        return self._send(200, node.dht_view(owner_of))
+
     @staticmethod
-    def _cluster_view(node) -> dict:
+    def _cluster_view(node, sample: int = 0) -> dict:
         """The node's cluster-scope metrics view (single-node shape for a
         bare engine that predates the cluster surface)."""
         fn = getattr(node, "cluster_metrics_view", None)
         if fn is not None:
-            return fn()
+            return fn(sample=sample) if sample else fn()
         engine = getattr(node, "engine", None)
         m = engine.metrics() if engine is not None else {}
         addr = getattr(node, "address", "local:0")
@@ -653,8 +702,24 @@ class _Handler(BaseHTTPRequestHandler):
         rollup; ``&format=prometheus`` renders the federated form (the
         rollup's series plus per-node reachability gauges — per-node full
         bodies stay JSON-only, each member already serves its own
-        exposition)."""
-        cm = self._cluster_view(node)
+        exposition).  ``&sample=N`` pulls a deterministic stride sample
+        of N members instead of all of them — the O(1)-per-scrape mode
+        for 500-member rings (the rollup then carries
+        ``members_total``/``members_sampled``)."""
+        sample = 0
+        if "sample" in query:
+            try:
+                sample = int(query["sample"][0])
+            except ValueError:
+                return self._send(
+                    400, {"error": "sample must be an integer"}
+                )
+            if sample <= 0:
+                return self._send(
+                    400,
+                    {"error": f"sample must be positive, got {sample}"},
+                )
+        cm = self._cluster_view(node, sample)
         if query.get("format", [""])[0] == "prometheus":
             from distributed_sudoku_solver_tpu.obs import prom
 

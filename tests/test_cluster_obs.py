@@ -585,6 +585,53 @@ def test_regress_gate_exit_codes(tmp_path):
     assert any("p95" in r for r in rep["regressions"])
 
 
+def test_regress_gates_ring_tier(tmp_path):
+    """ISSUE 17 satellite: when both artifacts carry the --ring (DHT)
+    section with the same node count, the cluster-cache hit rate is
+    gated like a latency quantile — and a run whose cluster rate no
+    longer strictly exceeds the no-DHT control pass's best per-node
+    rate fails outright (the DHT stopped sharing fills).  Mismatched
+    node counts or a one-sided section only earn notes."""
+    regress = _load_regress()
+
+    def ring(cluster, best, nodes=3):
+        doc = _artifact(100.0, 400.0)
+        doc["ring"] = {
+            "nodes": nodes, "jobs": 64, "mix": "easy:12,hard:4,repeat:48",
+            "cluster_hit_rate": cluster, "best_node_hit_rate": best,
+            "solo_node_hit_rates": [best] * nodes,
+            "l2": {"remote_hits": 8, "puts_applied": 11},
+            "per_node": {},
+        }
+        return doc
+
+    def write(name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    base = write("base.json", ring(0.72, 0.62))
+    assert regress.main([base, write("same.json", ring(0.72, 0.62))]) == 0
+    # Hit rate collapsed beyond tolerance -> regression exit.
+    assert regress.main([base, write("drop.json", ring(0.40, 0.30))]) == 1
+    # Still within tolerance but no longer beats the best solo member
+    # -> the DHT-specific invariant fails even when the delta is small.
+    assert regress.main([base, write("tied.json", ring(0.62, 0.62))]) == 1
+    rep = regress.compare(ring(0.72, 0.62), ring(0.62, 0.62))
+    assert any("no longer exceeds" in r for r in rep["regressions"])
+    # Different deployment shape: noted, never gated.
+    rep = regress.compare(ring(0.72, 0.62), ring(0.30, 0.10, nodes=5))
+    assert not rep["regressions"]
+    assert any("node counts differ" in n for n in rep["notes"])
+    # One-sided ring section: noted, never gated.
+    rep = regress.compare(_artifact(100.0, 400.0), ring(0.72, 0.62))
+    assert not rep["regressions"]
+    assert any("only the new artifact carries the ring" in n
+               for n in rep["notes"])
+    assert regress.main([base, write("noring.json",
+                                     _artifact(100.0, 400.0))]) == 0
+
+
 def test_regress_labels_cold_cache_runs(tmp_path, capsys):
     """Round-15 satellite: an artifact whose `compile` section says the
     run paid XLA compiles inside its measured window is LABELED in the

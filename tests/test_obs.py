@@ -300,7 +300,49 @@ PROM_SAMPLE = {
     "cluster": {
         "address": "10.0.0.1:7000",
         "view": [1, 4],
-        "faults": {"duplicates_dropped": {"SOLUTION": 2, "TASK": 1}},
+        "faults": {
+            "duplicates_dropped": {"SOLUTION": 2, "TASK": 1},
+            # Round-20 partition-survival counters: result sends parked
+            # after budget exhaustion, and their late re-deliveries.
+            "results_parked": 1,
+            "results_delivered_late": 1,
+        },
+    },
+    # Round-20 DHT plane (cluster/dht): gossip liveness counters, ring
+    # shape, the node's cluster-cache shard, and cache-affine routing —
+    # all plain counters/gauges (no new label dicts), rolled up across
+    # members by obs/agg._merge_dht.
+    "dht": {
+        "gossip": {
+            "alive": 3,
+            "suspect": 1,
+            "dead": 0,
+            "incarnation": 2,
+            "refutations": 1,
+            "suspicions": 2,
+            "deaths": 0,
+            "resurrections": 0,
+            "stale_ignored": 4,
+            "merged": 57,
+        },
+        "ring": {"members": 3, "vnodes": 32},
+        "cluster_cache": {
+            "entries": 4,
+            "capacity": 65536,
+            "lookups": 21,
+            "local_hits": 6,
+            "remote_hits": 9,
+            "negative_hits": 1,
+            "misses": 5,
+            "remote_errors": 1,
+            "puts_sent": 7,
+            "puts_failed": 1,
+            "puts_applied": 5,
+            "gets_served": 14,
+            "insertions": 9,
+            "evictions": 0,
+        },
+        "affinity": {"routed": 11, "declined": 2},
     },
     "fused_lane_occupancy": {"counts": [5, 0, 9], "mean_pct": 61.5},
     "device": {"kind": "cpu", "platform": "cpu"},
@@ -747,4 +789,54 @@ def test_cluster_trace_stitching_fault_dump_and_perfetto(tmp_path):
         for e in (ea, eb):
             if e is not None:
                 e.stop(timeout=1)
+        net.close()
+
+
+@pytest.mark.simnet
+def test_promck_over_live_gossip_node():
+    """Satellite (round 20): the prometheus body of a LIVE gossip member —
+    a 3-node simnet ring with the DHT plane on, a cross-member cache hit
+    behind it — passes promck and carries the dsst_dht_* families (gossip
+    liveness, ring shape, cluster-cache shard, affinity counters) plus
+    the round-20 partition-survival fault counters."""
+    from distributed_sudoku_solver_tpu.obs import promck
+    from distributed_sudoku_solver_tpu.cluster.simnet import SimNet, wait_until
+    from tests.test_dht import _dht_ring, _digest_of, _owner_node
+
+    net = SimNet()
+    net.nodes = []
+    try:
+        nodes, _calls = _dht_ring(net, 3)
+        a = nodes[0]
+        board = np.asarray(HARD_9[0], np.int32)
+        j = a.engine.submit(board)
+        assert j.wait(60) and j.solved, j.error
+        owner = _owner_node(nodes, _digest_of(board))
+        assert wait_until(net, lambda: len(owner.dcache) >= 1, timeout=30)
+        requester = next(n for n in nodes if n is not a and n is not owner)
+        j2 = requester.engine.submit(board)
+        assert j2.wait(60) and j2.solved and j2.route == "cache"
+
+        for member in (requester, owner):
+            raw = prom.render(member.metrics_view())
+            assert promck.check_text(raw) == [], promck.check_text(raw)[:5]
+            assert "dsst_dht_gossip_alive 3" in raw
+            assert "dsst_dht_ring_members 3" in raw
+            assert "dsst_dht_cluster_cache_capacity" in raw
+            assert "dsst_dht_affinity_routed" in raw
+            assert "dsst_cluster_faults_results_parked 0" in raw
+        assert (
+            "dsst_dht_cluster_cache_remote_hits 1"
+            in prom.render(requester.metrics_view())
+        )
+        # The owner served at least the requester's GET (A's warm-up
+        # lookup may have landed there too — don't pin the count).
+        assert owner.dcache.metrics()["gets_served"] >= 1
+        assert "dsst_dht_cluster_cache_gets_served" in prom.render(
+            owner.metrics_view()
+        )
+    finally:
+        for n in net.nodes:
+            n.kill()
+            n.engine.stop(timeout=1)
         net.close()
