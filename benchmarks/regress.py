@@ -38,6 +38,14 @@ gated).  Two checks: the cluster hit rate must not drop past the
 tolerance, and it must still strictly exceed the best per-node rate
 (the DHT's reason to exist).
 
+Mesh artifacts (``bench_poisson --mesh-devices N``, round 21) carry a
+``mesh`` section: the pod-scale resident tier's latency quantiles plus
+aggregate ``boards_per_s``.  Gated like megastep when both sides carry
+it — quantiles upward, throughput downward — but a *different device
+count* is a different machine shape (the section's slot pool and
+throughput scale with it), so mismatched counts refuse the compare
+(**exit 2**) rather than noting and skipping.
+
 Mixed-corpus artifacts (``bench_poisson --mix``, round 17) are only
 comparable to artifacts with the *identical* mix: the overall quantiles
 blend cache/native/device routes in mix-specific proportions, so a
@@ -232,6 +240,54 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
         notes.append(
             f"only the {only} artifact carries the ring (DHT) tier — "
             "that tier is NOT gated; run both sides with --ring to gate it"
+        )
+    # The pod-scale tier (bench_poisson --mesh-devices, round 21): gated
+    # when both artifacts carry it — the latency quantiles ride the same
+    # loop as static/resident (the section carries p50_ms/p95_ms), and
+    # aggregate boards_per_s is gated DOWNWARD (a throughput drop past
+    # the tolerance is the regression this tier exists to catch).  A
+    # DIFFERENT device count is a different machine shape, not a code
+    # delta: unlike the ring's noted-only node mismatch, the mesh
+    # section's whole claim (slot pool, boards/s) scales with the device
+    # count, so the compare is refused outright (exit 2).
+    has_mesh = {
+        label: isinstance(doc.get("mesh"), dict)
+        for label, doc in (("old", old), ("new", new))
+    }
+    if all(has_mesh.values()):
+        o_mesh, n_mesh = old["mesh"], new["mesh"]
+        if o_mesh.get("devices") != n_mesh.get("devices"):
+            return {
+                "comparable": False,
+                "errors": [
+                    f"mesh device counts differ ({o_mesh.get('devices')} vs "
+                    f"{n_mesh.get('devices')}) — a mesh artifact is only "
+                    "comparable to an artifact measured on the same mesh "
+                    "shape; re-run both sides with the same --mesh-devices"
+                ],
+                "regressions": [],
+                "improvements": [],
+                "notes": [],
+            }
+        sides.append("mesh")
+        o_tp = float(o_mesh.get("boards_per_s", 0.0))
+        n_tp = float(n_mesh.get("boards_per_s", 0.0))
+        if o_tp > 0 and n_tp < o_tp * (1.0 - tol):
+            regressions.append(
+                f"mesh boards_per_s: {o_tp:.2f} -> {n_tp:.2f} "
+                f"({(n_tp / o_tp - 1) * 100:.0f}%, tolerance "
+                f"{tol * 100:.0f}%)"
+            )
+        elif o_tp > 0 and n_tp > o_tp * (1.0 + tol):
+            improvements.append(
+                f"mesh boards_per_s: {o_tp:.2f} -> {n_tp:.2f}"
+            )
+    elif any(has_mesh.values()):
+        only = "old" if has_mesh["old"] else "new"
+        notes.append(
+            f"only the {only} artifact carries the mesh (pod-scale) tier "
+            "— that tier is NOT gated; run both sides with --mesh-devices "
+            "to gate it"
         )
     for side in sides:
         for q in QUANTS:
@@ -450,6 +506,11 @@ def main(argv: Union[List[str], None] = None) -> int:
             for d in (old, new)
         ):
             gated.append("megastep")
+        if all(
+            isinstance(d, dict) and isinstance(d.get("mesh"), dict)
+            for d in (old, new)
+        ):
+            gated.append("mesh")
         print(
             f"regress: OK — no regression beyond {args.tol * 100:.0f}% "
             f"({', '.join(f'{s} {q}' for s in gated for q in QUANTS)})"

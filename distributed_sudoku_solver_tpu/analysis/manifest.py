@@ -232,6 +232,7 @@ SYNC_SCOPED_FILES = (
     "ops/bulk.py",
     "serving/portfolio.py",
     "serving/megastep.py",
+    "serving/mesh_scheduler.py",
 )
 
 SYNC_HOT_REGIONS = {
@@ -263,12 +264,30 @@ SYNC_HOT_REGIONS = {
         "MegastepFlight.solve",
         "MegastepFlight._fly",
     ),
+    # The mesh-resident flight (round 21) inherits the scheduler's hot
+    # round bodies verbatim; only its strategy hooks are new code — they
+    # run INSIDE those pinned bodies, so they are hot regions themselves
+    # (the mesh loop's one-sync-per-chunk contract is the same proof).
+    "serving/mesh_scheduler.py": (
+        "MeshResidentFlight._unpack",
+        "MeshResidentFlight._advance_bound",
+        "MeshResidentFlight._mesh_attach",
+        "MeshResidentFlight._mesh_detach",
+    ),
 }
 
 # Functions whose BODY is the seam (exempt) and whose results prove their
 # targets host-side for the dataflow pass.
 SYNC_SEAM_FUNCS = ("host_fetch",)
-SYNC_HOST_SOURCES = ("host_fetch", "unpack_status")
+SYNC_HOST_SOURCES = (
+    "host_fetch",
+    "unpack_status",
+    "unpack_mesh_status",
+    # The flight's strategy hook: dispatches to unpack_status (single-chip)
+    # or unpack_mesh_status (mesh) over an already-host_fetch-ed word, so
+    # its result is host data by construction on either path.
+    "_unpack",
+)
 
 # numpy-module call names that force a device->host transfer when handed
 # a jax array (jnp.asarray is the opposite direction and exempt).
@@ -323,6 +342,15 @@ JAXCK_CANON = {
         "config_gang": {
             "lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64,
             "steal_gang": 2,
+        },
+        # The MESH-resident shape: what serving/mesh_scheduler._solver_config
+        # actually runs — home lanes excluded as steal thieves (the attach
+        # overwrite soundness flag; see SolverConfig.protect_home_lanes).
+        # A separate fixture so single-chip resident goldens stay pinned to
+        # the unprotected jaxpr they really compile.
+        "config_mesh": {
+            "lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64,
+            "steal_gang": 2, "protect_home_lanes": True,
         },
     },
 }
@@ -527,6 +555,47 @@ ENTRY_POINTS = (
         static={"geom": "geom", "config": "config", "mesh": "mesh"},
         donate=(), donation=None, hot=False,
     ),
+    # parallel/mesh_resident.py — the mesh-resident serving programs
+    # (round 21): the resident flight's init/attach/detach/advance twins
+    # under shard_map, donated through every state-threading dispatch like
+    # their single-chip parents (serving/scheduler.py above).  The
+    # canonical mesh is 1-device (goldens stay host-independent); the
+    # psum/ppermute/all_gather collectives degenerate to identities there,
+    # which is exactly the bit-identity-to-single-chip contract the mesh
+    # tests pin at runtime.
+    dict(
+        name="parallel.mesh_resident.mesh_init_resident", display="mesh_resident_init",
+        fn="distributed_sudoku_solver_tpu.parallel.mesh_resident:mesh_init_resident",
+        args=(),
+        static={"geom": "geom", "config": "config_mesh",
+                "n_slots": ("dim", "slots"), "mesh": "mesh"},
+        donate=(), donation=None, hot=True,
+    ),
+    dict(
+        name="parallel.mesh_resident.mesh_attach", display="mesh_resident_attach",
+        fn="distributed_sudoku_solver_tpu.parallel.mesh_resident:mesh_attach",
+        args=(
+            ("resident",),
+            ("array", ("G", "n", "n"), "int32"),
+            ("array", ("G",), "int32"),
+        ),
+        static={"geom": "geom", "gang": ("dim", "G"), "mesh": "mesh"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="parallel.mesh_resident.mesh_detach", display="mesh_resident_detach",
+        fn="distributed_sudoku_solver_tpu.parallel.mesh_resident:mesh_detach",
+        args=(("resident",), ("array", ("slots",), "bool")),
+        static={"mesh": "mesh"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="parallel.mesh_resident.mesh_advance_status", display="mesh_advance_status",
+        fn="distributed_sudoku_solver_tpu.parallel.mesh_resident:mesh_advance_status",
+        args=(("resident",), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config_mesh", "mesh": "mesh"},
+        donate=(0,), donation="threads", hot=True,
+    ),
 )
 
 # The ONE derivation of an entry's display name (explicit ``display``,
@@ -611,6 +680,13 @@ LOCK_RANKS = {
     "serving.brownout": 28,   # serving/brownout.py BrownoutController._lock
     "serving.engine": 30,     # serving/engine.py SolverEngine._lock
     "serving.scheduler": 34,  # serving/scheduler.py ResidentFlight._lock
+    # The mesh flight's telemetry lock sits between its parent's lock and
+    # the megastep: MeshResidentFlight.metrics acquires the inherited
+    # scheduler lock (34) and the mesh lock sequentially; rank 35 keeps
+    # even a future nested acquisition (scheduler -> mesh telemetry)
+    # rank-upward, while the reverse — holding the telemetry leaf into
+    # admission state — is a violation by construction.
+    "serving.mesh_scheduler": 35,  # serving/mesh_scheduler.py MeshResidentFlight._mesh_lock
     # Between the scheduler and the breaker: the megastep flight
     # (serving/megastep.py, round 19) is created under engine._lock
     # (30 < 36 legal) and consults its own circuit breaker under its
@@ -715,6 +791,10 @@ LOCK_EDGE_DECLARED.update({
         "serving.brownout",
         "serving.engine",
         "serving.scheduler",
+        # engine.metrics reads the mesh flight's telemetry section
+        # (round 21) — same injected-callable closure, same rank-upward
+        # legality (obs.slo 24 < serving.mesh_scheduler 35).
+        "serving.mesh_scheduler",
         # engine.metrics reads the megastep flight counters (round 19) —
         # same injected-callable closure, same rank-upward legality
         # (obs.slo 24 < serving.megastep 36).
@@ -749,6 +829,7 @@ DEADCK_BASE_CLASSES = {
     "ex": ("cluster/node.py", "_Exec"),
     "rf": ("serving/scheduler.py", "ResidentFlight"),
     "flight": ("serving/scheduler.py", "ResidentFlight"),
+    "mrf": ("serving/mesh_scheduler.py", "MeshResidentFlight"),
     "mf": ("serving/megastep.py", "MegastepFlight"),
     "self.breaker": ("serving/faults.py", "CircuitBreaker"),
     "req": ("serving/engine.py", "_Control"),

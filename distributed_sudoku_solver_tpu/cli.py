@@ -88,6 +88,15 @@ def build_parser() -> argparse.ArgumentParser:
         "429 + Retry-After",
     )
     ap.add_argument(
+        "--mesh-devices",
+        type=int,
+        default=0,
+        help="shard the resident flight's lane axis over N devices "
+        "(serving/mesh_scheduler.py): slot pool and throughput scale "
+        "with N, one host sync per chunk still.  0/1 = single-chip "
+        "resident flight; N must divide the visible device count",
+    )
+    ap.add_argument(
         "--latency-mode",
         action="store_true",
         help="serve every eligible /solve through the megastep tier "
@@ -344,6 +353,7 @@ def make_engine(args) -> SolverEngine:
             job_slots=args.resident_slots,
             gang_lanes=args.resident_gang,
             queue_depth=args.resident_queue,
+            mesh_devices=args.mesh_devices,
         )
     from distributed_sudoku_solver_tpu.serving.faults import RecoveryPolicy
 

@@ -99,6 +99,9 @@ ADVANCE_FUSED_STATUS = "ops.pallas_step.advance_frontier_fused_status"
 # flight per dispatch, so a recompile here is a whole-tier latency cliff.
 ADVANCE_MEGASTEP = "ops.frontier.advance_megastep"
 ADVANCE_MEGASTEP_FUSED = "ops.pallas_step.advance_megastep_fused"
+# The mesh-resident advance (serving/mesh_scheduler.py): the sharded
+# resident chunk program, one compile per (geometry, lanes, mesh).
+MESH_ADVANCE_STATUS = "parallel.mesh_resident.mesh_advance_status"
 
 #: The attribution bucket for compilations no registered program grew for.
 UNREGISTERED = "unregistered"

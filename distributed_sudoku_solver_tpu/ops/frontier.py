@@ -135,6 +135,18 @@ class SolverConfig:
     #   0 = global pairing (every batch-solve surface).  Must divide the
     #   lane count when set.
     ring_steal_k: int = 8  # max boards shipped per step per chip pair (sharded)
+    protect_home_lanes: bool = False  # home lanes (l % steal_gang == 0) never
+    #   act as steal THIEVES.  The mesh-resident flight's companion to
+    #   `ring_install_ok`: ring steal already never installs a foreign row
+    #   on a home lane (the next attach_roots overwrites it unconditionally,
+    #   losing the subtree), but without this flag the local gang-scoped
+    #   steal can relay one there — a freed slot's home lane is the
+    #   lowest-ranked idle lane in its gang, so it is the FIRST thief the
+    #   round after a detach.  With the flag on, a home lane only ever
+    #   carries its own slot's tag and the attach overwrite is sound.
+    #   Single-chip resident flights keep it off: gang lanes are
+    #   tag-homogeneous there, so detach always clears the home lane before
+    #   the next attach.  No-op when steal_gang == 0.
 
     def __post_init__(self) -> None:
         if self.branch_k not in (2, 3):
@@ -581,6 +593,7 @@ def _steal(
     job: jax.Array,
     job_live: jax.Array,
     gang: int = 0,
+    thief_ok: jax.Array | None = None,
 ):
     """Match idle lanes with working lanes; hand each thief a donor's *bottom* row.
 
@@ -591,10 +604,15 @@ def _steal(
     pairing to lane blocks, see :func:`pair_thieves_donors`).  The stolen
     row goes straight into the thief's ``top``, and the donor's bottom
     pointer bumps: no stack data moves on the donor side at all.
+
+    ``thief_ok`` (bool[L], optional) restricts which idle lanes may steal —
+    ``SolverConfig.protect_home_lanes`` passes the non-home-lane mask on the
+    mesh-resident path.  ``None`` keeps the original any-idle behavior and
+    the exact same jaxpr.
     """
     n_lanes, s = stack.shape[:2]
 
-    idle = ~has_top
+    idle = ~has_top if thief_ok is None else (~has_top & thief_ok)
     donor = has_top & (count >= 1) & job_live
     thief_lane, donor_lane, pair, n_pairs = pair_thieves_donors(
         idle, donor, n_lanes, gang
@@ -722,10 +740,13 @@ def frontier_step(
     n_steals = jnp.int32(0)
     job_arr = state.job
     if config.steal:
+        thief_ok = None
+        if config.protect_home_lanes and config.steal_gang > 0:
+            thief_ok = (lane_idx % config.steal_gang) != 0
         for _ in range(max(1, config.steal_rounds)):
             top, has_top, base, count, job_arr, k = _steal(
                 top, has_top, stack, base, count, job_arr, job_live,
-                gang=config.steal_gang,
+                gang=config.steal_gang, thief_ok=thief_ok,
             )
             job_live = (job_arr >= 0) & ~solved[jnp.clip(job_arr, 0, n_jobs - 1)]
             n_steals = n_steals + k

@@ -1,0 +1,386 @@
+"""Mesh-resident serving programs: the resident flight's lane axis sharded
+over a device mesh (ROADMAP #1, the pod-scale serving unlock).
+
+The single-chip resident flight (``serving/scheduler.py``) keeps one
+long-lived frontier per geometry and admits live traffic between chunk
+dispatches.  This module is its multi-chip twin: the SAME slot/gang/attach/
+detach/status contract, with the lane axis sharded over a 1-D mesh the way
+the bulk tier shards a batch solve (``parallel/sharded.py``):
+
+* **Slots are placed per-shard.**  Total slots ``J = per_shard * n_dev``;
+  slot ``s`` lives on shard ``s // per_shard`` and its gang of lanes
+  ``[s*gang, (s+1)*gang)`` falls entirely inside that shard (``gang`` divides
+  the per-shard lane count by construction).  Attach therefore touches
+  exactly one shard's lanes; per-job bookkeeping rows are replicated and
+  reset identically everywhere.
+* **Cross-shard steal = the bulk ring protocol, minus home lanes.**  Idle
+  lanes advertise to the ring predecessor and receive bottom stack rows
+  (``parallel/sharded._ring_steal``) — but a slot's HOME lane
+  (``slot * gang``) may never receive foreign rows: the next
+  ``attach_roots`` overwrites it unconditionally, so a stolen subtree
+  parked there would be lost (a false-unsat hazard).  The install mask
+  excludes lane 0 of every gang; with ``gang_lanes == 1`` there is no
+  install capacity and cross-shard steal is effectively off.
+* **Per-step psum solved merge.**  Same as the bulk tier: newly-solved
+  flags OR-merge every round, the lowest-shard winner's solution row is
+  broadcast, so the replicated ``solved`` / ``solution`` / ``overflowed``
+  rows stay bit-identical across shards at every step.
+* **Counters re-replicate at the chunk boundary.**  ``frontier_step``
+  scatters each shard's local harvests into its replicated copy of the
+  per-job ``nodes`` / ``sol_count`` rows, which therefore diverge WITHIN a
+  chunk; the advance program re-replicates them before returning
+  (``base + psum(delta)``), so verdict fetches between chunks read exact
+  global counts from any shard.
+* **One fetch per chunk, mesh edition.**  The packed status word
+  (``ops/frontier.chunk_status`` layout) is computed in-graph with the
+  lane reductions psummed across shards, then extended with mesh
+  telemetry: ring-steal volume and per-shard live / foreign-live lane
+  counts (``all_gather``).  ``unpack_mesh_status`` is the host-side
+  inverse; the serving loop still does ONE ``host_fetch`` per chunk.
+
+Composite step only: the fused Pallas kernel has its own sharded driver for
+bulk solves (``parallel/fused_sharded.py``) but no resident attach/detach
+twins — ``serving/mesh_scheduler.py`` downgrades a fused base config to the
+composite step before building the flight.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops.bitmask import encode_grid
+from distributed_sudoku_solver_tpu.ops.frontier import (
+    Frontier,
+    SolverConfig,
+    _pack_bits,
+    frontier_live,
+    status_len,
+    unpack_status,
+)
+from distributed_sudoku_solver_tpu.ops.solve import sudoku_csp
+from distributed_sudoku_solver_tpu.parallel.sharded import _sharded_step_counted
+from distributed_sudoku_solver_tpu.parallel.mesh import (
+    shard_map as _shard_map_compat,
+)
+
+# Mesh extension of the packed status word (ops/frontier.py layout docs):
+# the base ``status_len(J)`` words are followed by
+#   [base]                 ring-steal rows installed this chunk (all shards)
+#   [base+1 : base+1+D]    live lanes per shard (device saturation view)
+#   [base+1+D : base+1+2D] live lanes per shard working a FOREIGN job —
+#                          one whose home shard is elsewhere; nonzero means
+#                          cross-shard steal is actually balancing load
+MESH_STATUS_RING = 0  # offsets relative to status_len(n_jobs)
+
+
+def mesh_status_len(n_jobs: int, n_dev: int) -> int:
+    return status_len(n_jobs) + 1 + 2 * n_dev
+
+
+def unpack_mesh_status(status, n_jobs: int, n_dev: int) -> dict:
+    """Host-side inverse of the mesh status word: the base
+    :func:`~distributed_sudoku_solver_tpu.ops.frontier.unpack_status` dict
+    plus ``{ring_shipped, shard_live int64[D], shard_foreign int64[D]}``."""
+    import numpy as np
+
+    status = np.asarray(status)
+    base = status_len(n_jobs)
+    out = unpack_status(status[:base], n_jobs)
+    out["ring_shipped"] = int(status[base + MESH_STATUS_RING])
+    out["shard_live"] = status[base + 1 : base + 1 + n_dev].astype(np.int64)
+    out["shard_foreign"] = status[
+        base + 1 + n_dev : base + 1 + 2 * n_dev
+    ].astype(np.int64)
+    return out
+
+
+def _lane_specs(axis: str) -> Frontier:
+    """Canonical resident shardings: lane-axis leaves sharded, per-job rows
+    and scalars replicated (the bulk tier's ``lane_specs``, shared by every
+    mesh-resident program so the state never bounces between layouts)."""
+    return Frontier(
+        top=P(axis),
+        has_top=P(axis),
+        stack=P(axis),
+        base=P(axis),
+        count=P(axis),
+        job=P(axis),
+        solved=P(),
+        solution=P(),
+        overflowed=P(),
+        nodes=P(),
+        sol_count=P(),
+        steps=P(),
+        sweeps=P(),
+        expansions=P(),
+        steals=P(),
+        lane_rounds=P(axis),
+    )
+
+
+def _home_excluded(n_local: int, gang: int) -> jax.Array:
+    """bool[n_local]: lanes allowed to receive ring-stolen rows.
+
+    Gangs are shard-contained (``gang`` divides the local lane count), so
+    the shard offset is a multiple of ``gang`` and home lanes are exactly
+    the locally gang-aligned ones — no ``axis_index`` needed."""
+    return (jnp.arange(n_local, dtype=jnp.int32) % gang) != 0
+
+
+def _mesh_advance_body(
+    state: Frontier,
+    steps_delta: jax.Array,
+    problem,
+    config: SolverConfig,
+    axis: str,
+    n_dev: int,
+):
+    """Per-shard advance: the bounded-step chunk loop plus the chunk-boundary
+    collectives (counter re-replication + the extended status word).
+
+    Barrier diet (round 21): every collective on a forced-host CPU mesh is
+    a thread barrier, so the loop cond rides the liveness term fused into
+    the step's one psum (``_sharded_step_counted``) — one collective before
+    the loop instead of one per iteration — and the whole boundary
+    (counter re-replication + the psummed status reductions) collapses to
+    ONE fused psum plus ONE all_gather.  The status word layout is
+    byte-identical to the unfused form (``unpack_mesh_status``)."""
+    n_jobs = state.solved.shape[0]
+    per_shard = n_jobs // n_dev
+    n_local = state.has_top.shape[0]
+    prev_steps = state.steps
+    prev_lane_rounds = state.lane_rounds
+    base_counts = (
+        state.nodes, state.sol_count, state.sweeps, state.expansions,
+        state.steals,
+    )
+    limit = jnp.minimum(
+        prev_steps + jnp.int32(steps_delta), jnp.int32(config.max_steps)
+    )
+    install_ok = _home_excluded(n_local, max(config.steal_gang, 1))
+
+    go0 = (
+        jax.lax.psum(jnp.any(frontier_live(state)).astype(jnp.int32), axis) > 0
+    )
+
+    def cond(carry):
+        st, _, go = carry
+        return go & (st.steps < limit)
+
+    def body(carry):
+        st, ring, _ = carry
+        st, shipped, live_count = _sharded_step_counted(
+            st, problem, config, axis, ring_install_ok=install_ok
+        )
+        return st, ring + shipped, live_count > 0
+
+    st, ring, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), go0)
+    )
+
+    # --- the whole chunk boundary as ONE fused psum -------------------------
+    # Counter re-replication deltas (solved / solution / overflowed are
+    # already psum-merged per step) + the status word's lane reductions,
+    # concatenated int32.
+    cur_counts = (
+        st.nodes, st.sol_count, st.sweeps, st.expansions, st.steals,
+    )
+    live = frontier_live(st)
+    job_safe = jnp.clip(st.job, 0, n_jobs - 1)
+    has_work_local = jnp.zeros(n_jobs, bool).at[job_safe].max(live, mode="drop")
+    delta = st.lane_rounds - prev_lane_rounds
+    chunk_rounds = jnp.maximum(st.steps - prev_steps, 1)
+    bucket = jnp.clip((delta * 10) // chunk_rounds, 0, 9)
+    fused = jnp.concatenate(
+        [jnp.atleast_1d(cur - b) for b, cur in zip(base_counts, cur_counts)]
+        + [
+            has_work_local.astype(jnp.int32),
+            jnp.atleast_1d(jnp.sum(delta, dtype=jnp.int32)),
+            jnp.zeros(10, jnp.int32).at[bucket].add(1),
+            jnp.atleast_1d(ring),
+        ]
+    )
+    fused = jax.lax.psum(fused, axis)
+    widths = (n_jobs, n_jobs, 1, 1, 1, n_jobs, 1, 10, 1)
+    parts, o = [], 0
+    for w in widths:
+        parts.append(fused[o : o + w])
+        o += w
+    nodes_d, sol_d, sweeps_d, exp_d, steals_d, hw, live_sum, hist, ring_sum = (
+        parts
+    )
+    nodes = base_counts[0] + nodes_d
+    sol_count = base_counts[1] + sol_d
+    sweeps = base_counts[2] + sweeps_d[0]
+    expansions = base_counts[3] + exp_d[0]
+    steals = base_counts[4] + steals_d[0]
+    has_work = hw > 0
+    if not config.count_all:
+        sol_count = jnp.minimum(sol_count, 1)
+    st = st._replace(
+        nodes=nodes, sol_count=sol_count, sweeps=sweeps,
+        expansions=expansions, steals=steals,
+    )
+
+    # The packed status word (chunk_status's exact layout so the host-side
+    # unpack is shared), per-shard gauges via one fused all_gather.
+    my_shard = jax.lax.axis_index(axis).astype(jnp.int32)
+    foreign = live & ((st.job // per_shard) != my_shard)
+    gathered = jax.lax.all_gather(
+        jnp.stack(
+            [
+                jnp.sum(live, dtype=jnp.int32),
+                jnp.sum(foreign, dtype=jnp.int32),
+            ]
+        ),
+        axis,
+    )  # [D, 2]
+    status = jnp.concatenate(
+        [
+            jnp.stack([st.steps, live_sum[0]]),
+            hist,
+            _pack_bits(st.solved),
+            _pack_bits(has_work),
+            ring_sum,
+            gathered[:, 0],
+            gathered[:, 1],
+        ]
+    )
+    return st, status
+
+
+@functools.partial(
+    jax.jit, static_argnames=("geom", "config", "mesh"), donate_argnums=(0,)
+)
+def mesh_advance_status(
+    state: Frontier,
+    steps_delta: jax.Array,
+    geom: Geometry,
+    config: SolverConfig,
+    mesh: Mesh,
+):
+    """One mesh-resident serving chunk: advance every shard in lockstep by
+    at most ``steps_delta`` rounds and return ``(new_state, mesh status)``.
+
+    The mesh twin of ``utils/checkpoint.advance_frontier_status`` — same
+    donated-state, in-graph-limit, one-fetch contract; the status word is
+    the extended mesh layout (:func:`unpack_mesh_status`).
+    """
+    (axis,) = mesh.axis_names
+    specs = _lane_specs(axis)
+    body = _shard_map_compat(
+        functools.partial(
+            _mesh_advance_body,
+            problem=sudoku_csp(geom, config),
+            config=config,
+            axis=axis,
+            n_dev=mesh.devices.size,
+        ),
+        mesh=mesh,
+        in_specs=(specs, P()),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return body(state, jnp.int32(steps_delta))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("geom", "config", "n_slots", "mesh")
+)
+def mesh_init_resident(
+    geom: Geometry, config: SolverConfig, n_slots: int, mesh: Mesh
+) -> Frontier:
+    """The empty resident frontier, born sharded: each shard builds its own
+    local lane slice (all idle), per-job rows identical zeros everywhere."""
+    import dataclasses
+
+    from distributed_sudoku_solver_tpu.ops.frontier import init_frontier_roots
+
+    (axis,) = mesh.axis_names
+    n_local = config.lanes // mesh.devices.size
+
+    def body():
+        local_cfg = dataclasses.replace(
+            config, lanes=n_local, min_lanes=n_local
+        )
+        roots = jnp.zeros((n_local, geom.n, geom.n), jnp.uint32)
+        return init_frontier_roots(
+            roots, jnp.full(n_local, -1, jnp.int32), n_slots, local_cfg
+        )
+
+    return _shard_map_compat(
+        body, mesh=mesh, in_specs=(), out_specs=_lane_specs(axis),
+        check_vma=False,
+    )()
+
+
+@functools.partial(
+    jax.jit, static_argnames=("geom", "gang", "mesh"), donate_argnums=(0,)
+)
+def mesh_attach(
+    state: Frontier,
+    grids: jax.Array,
+    slot_ids: jax.Array,
+    geom: Geometry,
+    gang: int,
+    mesh: Mesh,
+) -> Frontier:
+    """``ops/frontier.attach_roots`` on the sharded resident state.
+
+    Lane scatters land on the one shard owning each slot's home lane
+    (global lane ``slot * gang``, rebased by the shard offset; other shards
+    drop them); the per-job bookkeeping resets are replicated — every shard
+    applies the identical update to its identical copy."""
+    (axis,) = mesh.axis_names
+    roots = encode_grid(grids, geom)
+
+    def body(st: Frontier, roots: jax.Array, slot_ids: jax.Array) -> Frontier:
+        n_local = st.has_top.shape[0]
+        n_jobs = st.solved.shape[0]
+        off = jax.lax.axis_index(axis).astype(jnp.int32) * n_local
+        ok = slot_ids >= 0
+        lane_g = slot_ids * gang
+        mine = ok & (lane_g >= off) & (lane_g < off + n_local)
+        lane = jnp.where(mine, lane_g - off, n_local)  # OOB -> dropped
+        slot_t = jnp.where(ok, slot_ids, n_jobs)
+        zero_k = jnp.zeros(slot_ids.shape[0], jnp.int32)
+        return st._replace(
+            top=st.top.at[lane].set(roots.astype(jnp.uint32), mode="drop"),
+            has_top=st.has_top.at[lane].set(mine, mode="drop"),
+            job=st.job.at[lane].set(slot_ids, mode="drop"),
+            base=st.base.at[lane].set(zero_k, mode="drop"),
+            count=st.count.at[lane].set(zero_k, mode="drop"),
+            solved=st.solved.at[slot_t].set(False, mode="drop"),
+            solution=st.solution.at[slot_t].set(jnp.uint32(0), mode="drop"),
+            overflowed=st.overflowed.at[slot_t].set(False, mode="drop"),
+            nodes=st.nodes.at[slot_t].set(zero_k, mode="drop"),
+            sol_count=st.sol_count.at[slot_t].set(zero_k, mode="drop"),
+        )
+
+    specs = _lane_specs(axis)
+    return _shard_map_compat(
+        body, mesh=mesh, in_specs=(specs, P(), P()), out_specs=specs,
+        check_vma=False,
+    )(state, roots, slot_ids)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh",), donate_argnums=(0,)
+)
+def mesh_detach(state: Frontier, slot_mask: jax.Array, mesh: Mesh) -> Frontier:
+    """``ops/frontier.detach`` per shard: lane clearing keys on the local
+    ``job`` tags (which travel with ring-stolen rows, so a leaving job's
+    foreign rows clear too); the bookkeeping resets are replicated."""
+    from distributed_sudoku_solver_tpu.ops.frontier import detach
+
+    (axis,) = mesh.axis_names
+    specs = _lane_specs(axis)
+    return _shard_map_compat(
+        detach, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
+        check_vma=False,
+    )(state, slot_mask)

@@ -73,6 +73,7 @@ def _ring_steal(
     job_live: jax.Array,
     axis: str,
     k: int,
+    install_ok: jax.Array | None = None,
 ):
     """Ship up to ``k`` bottom stack rows from this chip to its ring successor.
 
@@ -82,6 +83,14 @@ def _ring_steal(
     moves donor-side), and the receiver installs every row it gets straight
     into idle lanes' working tops (its idle count cannot have shrunk in
     between — the local steal already ran this step, nothing else touches it).
+
+    ``install_ok`` (bool[local lanes], optional) restricts which idle lanes
+    may RECEIVE foreign rows.  The mesh-resident flight
+    (``parallel/mesh_resident.py``) passes the non-home-lane mask: a slot's
+    home lane ``slot * gang`` is overwritten unconditionally by the next
+    ``attach_roots``, so a stolen row parked there would be silently lost —
+    a false-unsat hazard.  ``None`` (every bulk surface) keeps the original
+    any-idle-lane behavior and the exact same jaxpr.
     """
     n_dev = _axis_size_compat(axis)
     n_lanes, s = stack.shape[:2]
@@ -91,7 +100,7 @@ def _ring_steal(
     fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]  # donor -> successor
     back = [(i, (i - 1) % n_dev) for i in range(n_dev)]  # request travels back
 
-    idle = ~has_top
+    idle = ~has_top if install_ok is None else (~has_top & install_ok)
     n_idle = jnp.sum(idle).astype(jnp.int32)
     request = jax.lax.ppermute(n_idle, axis, back)  # my successor's idle count
 
@@ -110,9 +119,20 @@ def _ring_steal(
     base = jnp.where(donor_sel, (base + 1) % s, base)
     count = jnp.where(donor_sel, count - 1, count)
 
-    boards_in = jax.lax.ppermute(boards, axis, fwd)
-    jobs_in = jax.lax.ppermute(jobs, axis, fwd)
-    n_in = jax.lax.ppermute(n_send, axis, fwd)
+    # One fused forward payload (boards || job tag, plus a count row)
+    # instead of three ppermutes: on a forced-host CPU mesh each collective
+    # is a thread barrier, and the ring runs every step of the serving
+    # chunk loop.  int32 job tags (-1 padding included) round-trip through
+    # the uint32 bit-pattern exactly.
+    n_cells = boards.shape[1] * boards.shape[2]
+    payload = jnp.zeros((k + 1, n_cells + 1), jnp.uint32)
+    payload = payload.at[:k, :n_cells].set(boards.reshape(k, n_cells))
+    payload = payload.at[:k, n_cells].set(jobs.astype(jnp.uint32))
+    payload = payload.at[k, 0].set(n_send.astype(jnp.uint32))
+    payload = jax.lax.ppermute(payload, axis, fwd)
+    boards_in = payload[:k, :n_cells].reshape(boards.shape)
+    jobs_in = payload[:k, n_cells].astype(jnp.int32)
+    n_in = payload[k, 0].astype(jnp.int32)
 
     install = slot_k < n_in
     thief_of = _lane_by_rank(idle, n_lanes)
@@ -124,9 +144,44 @@ def _ring_steal(
 
 
 def _sharded_step(
-    state: Frontier, problem: CSProblem, config: SolverConfig, axis: str
+    state: Frontier,
+    problem: CSProblem,
+    config: SolverConfig,
+    axis: str,
+    ring_install_ok: jax.Array | None = None,
 ) -> Frontier:
     """One lockstep round on every chip: local step, then cross-chip merges."""
+    return _sharded_step_counted(state, problem, config, axis, ring_install_ok)[0]
+
+
+def _sharded_step_counted(
+    state: Frontier,
+    problem: CSProblem,
+    config: SolverConfig,
+    axis: str,
+    ring_install_ok: jax.Array | None = None,
+):
+    """:func:`_sharded_step` plus this chip's ring-installed row count.
+
+    The mesh-resident advance loop (``parallel/mesh_resident.py``) carries
+    the per-chunk ring-steal volume in its status word; ``Frontier.steals``
+    cannot supply it because local (within-chip) steals accumulate into the
+    same counter.  Returns ``(new_state, rows installed here this round,
+    chips-with-live-work count)``.
+
+    The whole cross-chip resolution merge rides ONE fused psum (round 21's
+    barrier diet — on a forced-host CPU mesh every collective is a thread
+    barrier, and the old psum + pmin + psum + psum chain dominated the
+    serving chunk cadence).  Each chip contributes its newly-solved flags as
+    a one-hot row over devices plus its candidate solution boards; after the
+    single sum every chip picks the lowest-chip winner with a local argmax —
+    bit-identical to the pmin chain, at one barrier.  The local-liveness
+    term folded into the same vector lets the mesh advance loop's cond run
+    collective-free; it is summed BEFORE the remote solved flags land, so a
+    chip whose last job was solved elsewhere this round reads live for one
+    extra (no-op) step — the loop never terminates early, only one cheap
+    step late.
+    """
     n_jobs = state.solved.shape[0]
     n_dev = _axis_size_compat(axis)
     prev_solved = state.solved
@@ -134,24 +189,41 @@ def _sharded_step(
 
     st = frontier_step(state, problem, config)
 
-    # --- merge job resolution across chips (the SOLUTION_FOUND broadcast) ---
+    # --- merge job resolution across chips (the SOLUTION_FOUND broadcast,
+    # one fused collective) --------------------------------------------------
     newly = st.solved & ~prev_solved
-    newly_any = jax.lax.psum(newly.astype(jnp.int32), axis) > 0
     dev = jax.lax.axis_index(axis).astype(jnp.int32)
-    key = jnp.where(newly, dev, jnp.int32(n_dev))
-    winner = jax.lax.pmin(key, axis)
-    contrib = jnp.where(
-        (newly & (key == winner))[:, None, None], st.solution, jnp.uint32(0)
+    onehot = jnp.arange(n_dev, dtype=jnp.int32) == dev  # [D]
+    newly_oh = newly[:, None] & onehot[None, :]  # [J, D]
+    sol_by_dev = jnp.where(
+        newly_oh[:, :, None, None], st.solution[:, None], jnp.uint32(0)
+    )  # [J, D, n, n]
+    live_local = jnp.any(frontier_live(st))
+    fused = jnp.concatenate(
+        [
+            newly_oh.astype(jnp.uint32).reshape(-1),
+            st.overflowed.astype(jnp.uint32),
+            jnp.atleast_1d(live_local.astype(jnp.uint32)),
+            sol_by_dev.reshape(-1),
+        ]
     )
-    solution = jnp.where(
-        newly_any[:, None, None], jax.lax.psum(contrib, axis), prev_solution
+    fused = jax.lax.psum(fused, axis)
+    newly_mat = fused[: n_jobs * n_dev].reshape(n_jobs, n_dev) > 0
+    overflowed = fused[n_jobs * n_dev : n_jobs * n_dev + n_jobs] > 0
+    live_count = fused[n_jobs * n_dev + n_jobs].astype(jnp.int32)
+    sols = fused[n_jobs * n_dev + n_jobs + 1 :].reshape(
+        (n_jobs, n_dev) + st.solution.shape[1:]
     )
+    newly_any = jnp.any(newly_mat, axis=1)
+    winner = jnp.argmax(newly_mat, axis=1)  # first True = lowest chip
+    contrib = sols[jnp.arange(n_jobs), winner].astype(jnp.uint32)
+    solution = jnp.where(newly_any[:, None, None], contrib, prev_solution)
     solved = prev_solved | newly_any
-    overflowed = jax.lax.psum(st.overflowed.astype(jnp.int32), axis) > 0
 
     # --- cross-chip work rebalance (NEEDWORK over the ICI ring) -------------
     top, has_top, base, count, job = st.top, st.has_top, st.base, st.count, st.job
     steals = st.steals
+    shipped = jnp.int32(0)
     if n_dev > 1 and config.steal and config.ring_steal_k > 0:
         job_safe = jnp.clip(job, 0, n_jobs - 1)
         job_live = (job >= 0) & ~solved[job_safe]
@@ -159,7 +231,7 @@ def _sharded_step(
         count = jnp.where(job_live, count, 0)
         top, has_top, base, count, job, shipped = _ring_steal(
             top, has_top, st.stack, base, count, job, job_live,
-            axis, config.ring_steal_k,
+            axis, config.ring_steal_k, ring_install_ok,
         )
         steals = steals + shipped
 
@@ -180,7 +252,7 @@ def _sharded_step(
         expansions=st.expansions,
         steals=steals,
         lane_rounds=st.lane_rounds,
-    )
+    ), shipped, live_count
 
 
 def _run_sharded(

@@ -357,6 +357,8 @@ class SolverEngine:
         self._resident: dict = {}  # Geometry -> ResidentFlight
         self.resident_unfit = 0  # lockck: guard(_lock) — geometries the resident fused shape
         #   cannot serve (fell back to static flights at submit time)
+        self.mesh_unfit = 0  # lockck: guard(_lock) — mesh-resident flights that
+        #   degraded to single-chip (too few devices / indivisible shapes)
         # Latency-mode serving megastep (serving/megastep.py, ISSUE 16):
         # single hard boards fuse their whole advance loop into ONE
         # donated dispatch with in-graph early exit — one host sync per
@@ -635,7 +637,27 @@ class SolverEngine:
             )
 
             try:
-                rf = ResidentFlight(self, geom, self.resident_config)
+                rf = None
+                if self.resident_config.mesh_devices > 1:
+                    # Pod-scale serving (serving/mesh_scheduler.py): shard
+                    # the flight over a device mesh.  A misfit (too few
+                    # visible devices, indivisible shapes) degrades to the
+                    # single-chip flight, never to an error — the mesh is
+                    # capacity, not correctness.
+                    from distributed_sudoku_solver_tpu.serving.mesh_scheduler import (
+                        MeshResidentFlight,
+                    )
+
+                    try:
+                        rf = MeshResidentFlight(self, geom, self.resident_config)
+                    except ValueError as e:
+                        self.mesh_unfit += 1
+                        _LOG.warning(
+                            "[engine] mesh-resident flight unfit for %s "
+                            "(single-chip fallback): %s", geom, e,
+                        )
+                if rf is None:
+                    rf = ResidentFlight(self, geom, self.resident_config)
             except ValueError as e:
                 self.resident_unfit += 1
                 self._resident[geom] = None  # don't re-derive per submit
@@ -854,6 +876,8 @@ class SolverEngine:
             }
         if self.resident_unfit:
             out["resident_unfit"] = int(self.resident_unfit)
+        if self.mesh_unfit:
+            out["mesh_unfit"] = int(self.mesh_unfit)
         megastep_flights = self._megastep_flights()
         if megastep_flights:
             # Latency-mode megastep observability (serving/megastep.py):

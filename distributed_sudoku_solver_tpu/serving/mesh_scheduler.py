@@ -1,0 +1,166 @@
+"""Pod-scale resident serving: the resident flight sharded over a device
+mesh (ROADMAP #1).
+
+``MeshResidentFlight`` IS a ``ResidentFlight`` — same admission queue, FIFO
+fairness, deadlines, cancel, 429 backpressure, breaker-guarded rebuild, and
+the round-8 one-sync-per-chunk loop, all inherited verbatim.  What changes
+is the strategy underneath the hooks:
+
+* the device programs are the shard_map twins
+  (``parallel/mesh_resident.py``): lane axis sharded over a 1-D mesh,
+  donated through every program, per-step psum solved merge, cross-shard
+  ring steal with home lanes excluded from installs;
+* ``job_slots`` becomes the PER-SHARD slot count — the flight's pool is
+  ``job_slots * mesh_devices``, so admission capacity (and aggregate
+  boards/s) scales with the mesh while the per-job gang width stays fixed;
+* the status word carries mesh telemetry (ring-steal volume, per-shard
+  live / foreign-live lanes) decoded by the ``_unpack`` hook into the
+  ``metrics()["mesh"]`` section — still ONE ``host_fetch`` per chunk;
+* shard loss surfaces as a failed collective in the advance/attach/detach
+  program: ``ResidentFlight.on_failure`` classifies it transient, drops
+  the donated state, requeues held jobs, and rebuilds through the round-9
+  breaker — the ``mesh.*`` FaultSchedule sites below let tests inject the
+  fault exactly at the collective seams.
+
+Composite step only: the fused kernel has no sharded resident twins, so a
+fused base config is downgraded to ``step_impl='xla'`` for the mesh flight
+(the single-chip resident and the bulk fused-sharded tier are unaffected).
+
+Slot placement: slot ``s`` lives on shard ``s // job_slots``; its gang is
+shard-contained by construction.  With ``gang_lanes == 1`` every lane is a
+home lane and cross-shard steal has no install capacity — allowed, but a
+mesh flight wants ``gang_lanes >= 2`` to actually balance load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+
+import jax
+import numpy as np
+
+from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.obs import compilewatch, lockdep
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.parallel.mesh import make_mesh
+from distributed_sudoku_solver_tpu.parallel.mesh_resident import (
+    mesh_advance_status,
+    mesh_attach,
+    mesh_detach,
+    mesh_init_resident,
+    unpack_mesh_status,
+)
+from distributed_sudoku_solver_tpu.serving import faults
+from distributed_sudoku_solver_tpu.serving.scheduler import (
+    ResidentConfig,
+    ResidentFlight,
+    resident_solver_config,
+)
+
+_LOG = logging.getLogger(__name__)
+
+
+class MeshResidentFlight(ResidentFlight):
+    """One long-lived MESH-resident frontier: ``ResidentFlight`` with the
+    device programs swapped for the shard_map twins.
+
+    Raises ``ValueError`` when ``rcfg.mesh_devices < 2`` or more devices
+    are requested than visible — the engine degrades to the single-chip
+    flight (``SolverEngine._resident_for``), never silently under-shards.
+    """
+
+    def __init__(self, engine, geom: Geometry, rcfg: ResidentConfig):
+        n_dev = rcfg.mesh_devices
+        if n_dev < 2:
+            raise ValueError(
+                f"mesh_devices must be >= 2 for a mesh flight, got {n_dev}"
+            )
+        devices = jax.devices()
+        if len(devices) < n_dev:
+            raise ValueError(
+                f"mesh_devices={n_dev} but only {len(devices)} visible"
+            )
+        self.mesh = make_mesh(devices[:n_dev])
+        self.mesh_devices = n_dev
+        super().__init__(engine, geom, rcfg)
+        self._attach_fn = self._mesh_attach
+        self._detach_fn = self._mesh_detach
+        self._init_fn = functools.partial(mesh_init_resident, mesh=self.mesh)
+        # Mesh telemetry decoded from the chunk status word (_unpack runs
+        # on the device loop; metrics() reads from any thread).
+        self._mesh_lock = lockdep.named_lock("serving.mesh_scheduler")  # lockck: name(serving.mesh_scheduler)
+        self.ring_shipped = 0  # lockck: guard(_mesh_lock) — rows stolen cross-shard
+        self._shard_live = np.zeros(n_dev, np.int64)  # lockck: guard(_mesh_lock)
+        self._shard_foreign = np.zeros(n_dev, np.int64)  # lockck: guard(_mesh_lock)
+
+    # -- strategy hooks ------------------------------------------------------
+    def _solver_config(
+        self, base: SolverConfig, geom: Geometry, rcfg: ResidentConfig
+    ) -> SolverConfig:
+        if base.step_impl == "fused":
+            base = dataclasses.replace(base, step_impl="xla")
+        # Home lanes must never receive stolen rows on the mesh: ring steal
+        # makes gangs tag-heterogeneous, and a foreign row relayed onto a
+        # freed slot's home lane is destroyed by the next attach overwrite
+        # (a false-unsat, no overflow flag).  See SolverConfig.
+        base = dataclasses.replace(base, protect_home_lanes=True)
+        total = dataclasses.replace(
+            rcfg, job_slots=rcfg.job_slots * rcfg.mesh_devices
+        )
+        return resident_solver_config(base, geom, total)
+
+    def _unpack(self, raw) -> dict:
+        status = unpack_mesh_status(raw, self.n_slots, self.mesh_devices)
+        with self._mesh_lock:
+            self.ring_shipped += status["ring_shipped"]
+            self._shard_live = status["shard_live"]
+            self._shard_foreign = status["shard_foreign"]
+        return status
+
+    def _advance_bound(self):
+        if faults.active() is not None:
+            faults.fire(
+                "mesh.advance",
+                uuids=tuple(j.uuid for j in self.slots if j is not None),
+            )
+        return (
+            mesh_advance_status,
+            compilewatch.MESH_ADVANCE_STATUS,
+            {"mesh": self.mesh},
+        )
+
+    def _mesh_attach(self, state, grids, slot_ids, geom, gang):
+        if faults.active() is not None:
+            faults.fire("mesh.attach")
+        return mesh_attach(state, grids, slot_ids, geom, gang, mesh=self.mesh)
+
+    def _mesh_detach(self, state, slot_mask):
+        if faults.active() is not None:
+            faults.fire("mesh.detach")
+        return mesh_detach(state, slot_mask, mesh=self.mesh)
+
+    # -- any-thread surface --------------------------------------------------
+    def metrics(self) -> dict:
+        out = super().metrics()
+        per = self.rcfg.job_slots
+        with self._lock:
+            occupancy = [
+                sum(
+                    1
+                    for s in self.slots[d * per : (d + 1) * per]
+                    if s is not None
+                )
+                for d in range(self.mesh_devices)
+            ]
+        with self._mesh_lock:
+            out["mesh"] = {
+                "devices": self.mesh_devices,
+                "slot_occupancy": occupancy,  # per-shard occupied slots
+                "shard_live_lanes": [int(x) for x in self._shard_live],
+                "shard_foreign_lanes": [int(x) for x in self._shard_foreign],
+                "ring_shipped": int(self.ring_shipped),
+                "rebuilds": int(self.rebuilds),
+            }
+        return out

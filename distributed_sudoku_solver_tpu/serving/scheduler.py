@@ -115,6 +115,11 @@ class ResidentConfig:
     #   engine's static-flight chunk_steps
     default_deadline_s: float = 300.0  # wall-clock budget per job (the
     #   resident flight has no per-job step budget; deadlines bound it)
+    mesh_devices: int = 0  # > 1: shard the resident flight's lane axis over
+    #   a device mesh of this size (serving/mesh_scheduler.py) — job_slots
+    #   becomes the PER-SHARD slot count, so capacity scales with the mesh.
+    #   0/1 = the single-chip flight.  Engines fall back to single-chip
+    #   when fewer devices are visible (SolverEngine._resident_for).
 
     def __post_init__(self) -> None:
         if self.job_slots < 1:
@@ -125,6 +130,10 @@ class ResidentConfig:
             raise ValueError(f"queue_depth must be >= 1, got {self.queue_depth}")
         if self.attach_batch < 1:
             raise ValueError(f"attach_batch must be >= 1, got {self.attach_batch}")
+        if self.mesh_devices < 0:
+            raise ValueError(
+                f"mesh_devices must be >= 0, got {self.mesh_devices}"
+            )
 
 
 # -- jitted device programs (module-level: caches shared across engines) ------
@@ -230,9 +239,20 @@ class ResidentFlight:
         self.engine = engine
         self.geom = geom
         self.rcfg = rcfg
-        self.config = resident_solver_config(engine.config, geom, rcfg)
+        self.config = self._solver_config(engine.config, geom, rcfg)
         self.gang = self.config.steal_gang
-        self.n_slots = rcfg.job_slots
+        # lanes = gang * slots by construction (resident_solver_config) —
+        # derived here so the mesh subclass's total (per-shard * devices)
+        # slot pool needs no second hook.
+        self.n_slots = self.config.lanes // self.gang
+        # Device-program bindings: the mesh flight
+        # (serving/mesh_scheduler.py) rebinds these to its shard_map twins
+        # and every hot-loop method below is shared verbatim — the one-sync
+        # round structure is the contract, the programs are the strategy.
+        self._init_fn = _init_resident
+        self._attach_fn = _attach_jit
+        self._detach_fn = _detach_jit
+        self._verdict_fn = _verdict_jit
         self.state: Optional[Frontier] = None  # created lazily on the loop
         # Pipelined status plumbing (round 8): the un-fetched packed status
         # word of the most recent advance dispatch, and the host-side copy
@@ -293,6 +313,33 @@ class ResidentFlight:
         self.rounds_total = 0
         self.round_wall_total = 0.0
         self._steps_seen = 0
+
+    # -- strategy hooks (the mesh flight overrides these) --------------------
+    def _solver_config(
+        self, base: SolverConfig, geom: Geometry, rcfg: ResidentConfig
+    ) -> SolverConfig:
+        return resident_solver_config(base, geom, rcfg)
+
+    def _unpack(self, raw) -> dict:
+        """Host-side decode of the fetched status word (numpy only — the
+        round's one sync already happened in ``_consume_status``)."""
+        return unpack_status(raw, self.n_slots)
+
+    def _advance_bound(self):
+        """``(advance fn, compilewatch program name, extra static kwargs)``
+        — the strategy half of ``_advance``; the dispatch/trace/cost-seam
+        body stays shared."""
+        if self.config.step_impl == "fused":
+            from distributed_sudoku_solver_tpu.ops.pallas_step import (
+                advance_frontier_fused_status as fn,
+            )
+
+            return fn, compilewatch.ADVANCE_FUSED_STATUS, {}
+        from distributed_sudoku_solver_tpu.utils.checkpoint import (
+            advance_frontier_status as fn,
+        )
+
+        return fn, compilewatch.ADVANCE_STATUS, {}
 
     # -- any-thread surface --------------------------------------------------
     #: admit() verdicts.  SATURATED is the only one a reject-mode caller
@@ -461,7 +508,7 @@ class ResidentFlight:
             self._pending_status, floor_s=self.engine.handicap_s
         )
         self._pending_status = None
-        self._status = unpack_status(raw, self.n_slots)
+        self._status = self._unpack(raw)
         sync_s = self.engine._clock() - t0
         self.chunk_wall.record(sync_s)
         # The mergeable twin + the floor estimator (obs/hist.py): resident
@@ -586,7 +633,7 @@ class ResidentFlight:
             tr_ev = rec.now() if rec is not None else 0.0
             t_ev = self.engine._clock()
             nodes, sol_counts, overflowed, solutions = engine_mod.host_fetch(
-                _verdict_jit(self.state),
+                self._verdict_fn(self.state),
                 floor_s=self.engine.handicap_s,
                 tag="event",
             )
@@ -633,7 +680,7 @@ class ResidentFlight:
                 "resident.detach",
                 uuids=tuple(j.uuid for j in self.slots if j is not None),
             )
-        self.state = _detach_jit(self.state, jnp.asarray(detach_mask))
+        self.state = self._detach_fn(self.state, jnp.asarray(detach_mask))
 
     def _attach_pending(self) -> None:
         """FIFO-drain the admission queue into free slots, one jit-stable
@@ -681,7 +728,7 @@ class ResidentFlight:
                 "resident.attach", uuids=tuple(job.uuid for _, job in batch)
             )
         if self.state is None:
-            self.state = _init_resident(self.geom, self.config, self.n_slots)
+            self.state = self._init_fn(self.geom, self.config, self.n_slots)
         n = self.geom.n
         k = self.rcfg.attach_batch
         grids = np.zeros((k, n, n), np.int32)
@@ -692,7 +739,7 @@ class ResidentFlight:
             wait_s = now - job.submitted_at
             self.admission_wait.record(wait_s)
             self.engine.hist["admission_wait_ms"].record(wait_s)
-        self.state = _attach_jit(
+        self.state = self._attach_fn(
             self.state, jnp.asarray(grids), jnp.asarray(slot_ids),
             self.geom, self.gang,
         )
@@ -718,14 +765,7 @@ class ResidentFlight:
                 steps=jnp.int32(0),
                 lane_rounds=jnp.zeros_like(self.state.lane_rounds),
             )
-        if self.config.step_impl == "fused":
-            from distributed_sudoku_solver_tpu.ops.pallas_step import (
-                advance_frontier_fused_status as _advance_fn,
-            )
-        else:
-            from distributed_sudoku_solver_tpu.utils.checkpoint import (
-                advance_frontier_status as _advance_fn,
-            )
+        _advance_fn, _advance_prog, _statics = self._advance_bound()
         if faults.active() is not None:
             faults.fire(
                 "resident.advance",
@@ -734,7 +774,8 @@ class ResidentFlight:
         rec = trace.active()
         tr0 = rec.now() if rec is not None else 0.0
         self.state, self._pending_status = _advance_fn(
-            self.state, jnp.int32(self.rcfg.chunk_steps), self.geom, self.config
+            self.state, jnp.int32(self.rcfg.chunk_steps), self.geom,
+            self.config, **_statics,
         )
         if rec is not None:
             rec.record(
@@ -750,20 +791,15 @@ class ResidentFlight:
             # round(s), and ``.lower()`` reads aval shapes only (no
             # device sync; the fetch-count guard runs with the watch
             # installed to prove it).
-            prog = (
-                compilewatch.ADVANCE_FUSED_STATUS
-                if self.config.step_impl == "fused"
-                else compilewatch.ADVANCE_STATUS
-            )
             # .shape is host-side metadata (a tuple of ints, no sync).
             lanes = self.state.has_top.shape[0]
             cw.capture_cost(
-                prog,
+                _advance_prog,
                 (self.geom.n, lanes, self.config.stack_slots,
                  self.config.step_impl, "resident"),
                 lambda: _advance_fn.lower(
                     self.state, jnp.int32(self.rcfg.chunk_steps),
-                    self.geom, self.config,
+                    self.geom, self.config, **_statics,
                 ),
                 geometry=f"{self.geom.n}x{self.geom.n}",
                 lanes=lanes,

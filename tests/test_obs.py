@@ -290,7 +290,20 @@ PROM_SAMPLE = {
     "solved": 40,
     "job_latency_ms": {"count": 10, "p50": 1.5, "p95": 20.25},
     "resident": {
-        "9x9": {"occupied": 3, "queued": 0},
+        # Round-21 mesh section (serving/mesh_scheduler): per-shard gauges
+        # render as indexed numeric-list series, counters as plain leaves.
+        "9x9": {
+            "occupied": 3,
+            "queued": 0,
+            "mesh": {
+                "devices": 4,
+                "slot_occupancy": [2, 1, 0, 0],
+                "shard_live_lanes": [6, 3, 1, 0],
+                "shard_foreign_lanes": [0, 2, 1, 0],
+                "ring_shipped": 61,
+                "rebuilds": 1,
+            },
+        },
         "16x16": {"occupied": 1, "queued": 2},
     },
     "faults": {
@@ -436,7 +449,7 @@ PROM_SAMPLE = {
             },
             "unregistered": {"count": 3, "wall_ms_total": 40.25},
         },
-        "registered": 23,
+        "registered": 27,
         "compiles_total": 4,
         "recompiles_total": 0,
         "warmup_over": True,
@@ -647,7 +660,7 @@ def test_promck_over_live_prometheus_endpoint():
     # program, the cost plane's efficiency gauge is live, and the
     # critical-path histograms joined the mergeable hist keyspace.
     assert "dsst_compile_compiles_total" in raw
-    assert "dsst_compile_registered 23" in raw
+    assert "dsst_compile_registered 27" in raw
     assert 'dsst_cost_programs_flops{program="advance_status"}' in raw
     assert "dsst_cost_efficiency_achieved_gflops_per_s" in raw
     assert "dsst_critpath_jobs" in raw
