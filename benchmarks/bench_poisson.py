@@ -272,6 +272,7 @@ def compare_poisson(
     mix: Optional[dict] = None,
     record_workload: bool = False,
     latency_mode: bool = False,
+    branch: str = "minrem",
 ) -> dict:
     """One A/B: identical arrival schedule against a static-flight engine
     and a resident-flight engine (same solver config, same chunk
@@ -303,7 +304,7 @@ def compare_poisson(
     from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
     from distributed_sudoku_solver_tpu.serving.scheduler import ResidentConfig
 
-    cfg = SolverConfig(min_lanes=8, stack_slots=16)
+    cfg = SolverConfig(min_lanes=8, stack_slots=16, branch=branch)
     tiers = None
     if mix is not None:
         boards, tiers = mixed_corpus(mix, seed)
@@ -342,6 +343,28 @@ def compare_poisson(
         )
         dst["tiers"] = _grouped_percentiles(lats, tiers)
 
+    def _search_section(dst: dict, jobs):
+        # Device search-effort totals (ISSUE 19 satellite): `searched` =
+        # jobs that needed at least one branch node (the bulk pipeline's
+        # counter, ops/bulk.py), `nodes` = total expanded nodes — the
+        # quantity the branch-ordering heads exist to shrink.  Additive
+        # artifact keys: regress.py gates the hard tier only when BOTH
+        # artifacts carry them.
+        def agg(js):
+            return {
+                "searched": sum(1 for j in js if j.nodes > 0),
+                "nodes": int(sum(j.nodes for j in js)),
+            }
+
+        dst["search"] = agg(jobs)
+        if tiers is not None:
+            by_tier: dict = {}
+            for t, j in zip(tiers, jobs):
+                by_tier.setdefault(t, []).append(j)
+            dst["search"]["tiers"] = {
+                t: agg(js) for t, js in sorted(by_tier.items())
+            }
+
     static = SolverEngine(
         config=cfg, max_batch=8, handicap_s=handicap_s,
         chunk_steps=chunk_steps, frontdoor=_make_frontdoor(),
@@ -352,6 +375,7 @@ def compare_poisson(
         assert all(j.solved for j in jobs), "static baseline failed a job"
         out["static"] = _percentiles(lats)
         _route_tier_sections(out["static"], lats, jobs)
+        _search_section(out["static"], jobs)
         m = static.metrics()
         out["static_walls"] = {
             k: m[k] for k in ("dispatch_wall_ms", "sync_wall_ms") if k in m
@@ -417,6 +441,7 @@ def compare_poisson(
             }
         out["resident"] = _percentiles(lats)
         _route_tier_sections(out["resident"], lats, jobs)
+        _search_section(out["resident"], jobs)
         m_full = resident.metrics()
         # A mixed corpus may route every board away from the device, in
         # which case no resident flight was ever built.
@@ -469,6 +494,7 @@ def compare_poisson(
             ), "megastep engine failed a job"
             out["megastep"] = _percentiles(lats)
             _route_tier_sections(out["megastep"], lats, jobs)
+            _search_section(out["megastep"], jobs)
             mm = mega.metrics()
             out["megastep_metrics"] = mm.get("megastep", {}).get("9x9", {})
             out["megastep_metrics"]["unfit"] = mm.get("megastep_unfit", 0)
@@ -771,6 +797,16 @@ def main() -> None:
     ap.add_argument("--chunk-steps", type=int, default=8)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument(
+        "--branch",
+        default="minrem",
+        help="branch-ordering rule for the device engines: a legacy rule "
+        "(minrem/first/mixed/minrem-desc) or a scored head "
+        "(head:minrem/head:cw-slack/head:mlp, ops/ordering.py).  A "
+        "non-default rule lands in the artifact params, so regress.py "
+        "refuses to compare across rules (different search tree, not a "
+        "regression)",
+    )
+    ap.add_argument(
         "--mix",
         default=None,
         help="mixed-difficulty corpus 'easy:N,hard:M,repeat:R' (repeats "
@@ -913,6 +949,7 @@ def main() -> None:
             mix=parse_mix(args.mix) if args.mix else None,
             record_workload=bool(args.workload_out),
             latency_mode=args.latency_mode,
+            branch=args.branch,
         )
         if args.mesh_devices:
             out["mesh"] = mesh_pass(
@@ -1000,6 +1037,16 @@ def main() -> None:
                 # artifacts stay byte-compatible (and comparable) for
                 # the default all-hard corpus.
                 **({"mix": args.mix} if args.mix else {}),
+                # Only present for non-default branch ordering (round
+                # 22): a different rule explores a different search
+                # tree, so regress.py refuses the cross-rule compare
+                # via the params mismatch; default-rule artifacts stay
+                # comparable to every earlier round.
+                **(
+                    {"branch": args.branch}
+                    if args.branch != "minrem"
+                    else {}
+                ),
             },
             "static": out["static"],
             "resident": out["resident"],

@@ -52,6 +52,15 @@ blend cache/native/device routes in mix-specific proportions, so a
 cross-mix compare is a different workload (**exit 2**), not a
 regression.
 
+Search-effort totals (``bench_poisson`` round 22, branch-ordering
+heads) ride each side as an additive ``search`` section: ``searched``
+(jobs that branched at all) and ``nodes`` (total expansions), per tier
+on mixed runs.  The HARD tier's searched count is gated upward like a
+quantile whenever both artifacts carry the keys — a branch-rule change
+that grows the hard tail's tree fails the gate even if wall-clock hides
+it.  Node totals are noted, never gated (resident stealing makes them
+timing-dependent).
+
 **Replay-vs-live** (round 18): when one artifact is a ``dsst-replay/1``
 prediction (``benchmarks/replay.py``) and the other a live
 ``dsst-bench-poisson/1`` run, the gate compares the replay's predicted
@@ -304,6 +313,48 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
                     f"{side} {q}: {o:.1f} -> {n:.1f} ms "
                     f"({(n / o - 1) * 100:.0f}%)"
                 )
+    # Search-effort gate (round 22, branch-ordering heads): the additive
+    # per-side `search` section carries searched (jobs that branched at
+    # all) and nodes (total expansions).  `searched` on the HARD tier is
+    # gated UPWARD — a branch-rule change that grows the hard tail's
+    # search tree is a regression even when wall-clock hides inside the
+    # latency band.  Mixed runs gate the hard tier specifically; the
+    # default all-hard corpus gates the side's overall totals.  Node
+    # totals are noted, not gated: resident scheduling expands a
+    # timing-dependent number of speculative nodes per run, so only the
+    # coarser searched count is stable enough to fail a build on.
+    for side in sides:
+        o_sec = (old.get(side) or {}).get("search")
+        n_sec = (new.get(side) or {}).get("search")
+        if not isinstance(o_sec, dict) or not isinstance(n_sec, dict):
+            if isinstance(o_sec, dict) != isinstance(n_sec, dict):
+                only = "old" if isinstance(o_sec, dict) else "new"
+                notes.append(
+                    f"only the {only} artifact carries {side} search "
+                    "totals — searched-count is NOT gated for that side"
+                )
+            continue
+        o_hard = (o_sec.get("tiers") or {}).get("hard", o_sec)
+        n_hard = (n_sec.get("tiers") or {}).get("hard", n_sec)
+        o_s, n_s = int(o_hard.get("searched", 0)), int(n_hard.get("searched", 0))
+        if o_s > 0 and n_s > o_s * (1.0 + tol):
+            regressions.append(
+                f"{side} hard-tier searched: {o_s} -> {n_s} "
+                f"(+{(n_s / o_s - 1) * 100:.0f}%, tolerance {tol * 100:.0f}%)"
+            )
+        elif o_s > 0 and n_s < o_s * (1.0 - tol):
+            improvements.append(
+                f"{side} hard-tier searched: {o_s} -> {n_s} "
+                f"({(n_s / o_s - 1) * 100:.0f}%)"
+            )
+        o_n, n_n = int(o_hard.get("nodes", 0)), int(n_hard.get("nodes", 0))
+        if o_n > 0 and abs(n_n - o_n) > tol * o_n:
+            notes.append(
+                f"{side} hard-tier nodes moved {o_n} -> {n_n} "
+                f"({(n_n / o_n - 1) * 100:+.0f}%) — node totals are "
+                "timing-dependent under resident stealing, so this is "
+                "informational, not gated"
+            )
     of, nf = old.get("rpc_floor_ms"), new.get("rpc_floor_ms")
     if isinstance(of, dict) and isinstance(nf, dict):
         o_min, n_min = float(of.get("min", 0)), float(nf.get("min", 0))

@@ -1,0 +1,310 @@
+"""Offline trainers for the learned branch-ordering pieces (ROADMAP #4).
+
+Four subcommands cover the whole loop from journal to shipped weights:
+
+* ``record``  — build per-branch training examples.  Sources: the built-in
+  hard-tail corpus (``--corpus N``: the three benchmark killers plus
+  generated 24-clue boards) and/or an ordering-trace JSONL from a real
+  deployment (``--trace FILE``: the sampled ``grid`` events recorded by
+  ``obs/ordertrace.py``).  Each solve replays host-side with the kernel's
+  own strategy (``ops/ordering.py:record_branch_examples``) and journals
+  every (chosen-cell features, subtree-nodes) decision.
+* ``train``   — fit the one-hidden-layer MLP on the recorded examples
+  (numpy Adam, MSE on ``log2(1 + subtree_nodes)``) and emit the
+  ``dsst-ordering-mlp/1`` weights JSON the ``head:mlp`` head loads.
+* ``fit-threshold`` — learn the front door's ``easy_score`` routing
+  threshold from recorded route/wall outcomes
+  (``serving/frontdoor/learn.py``) instead of the shipped constant.
+* ``eval``    — the head A/B on the hard-tail corpus: per-head searched /
+  node totals with verdict-equality checks (solutions oracle-validated,
+  unsat cross-checked by ``count_all``), emitted as the BENCH_r11
+  artifact section.
+
+``record``/``train``/``fit-threshold`` are numpy/stdlib only — they run
+wherever the trace was captured, no accelerator needed.  ``eval`` runs
+the real engine (set ``JAX_PLATFORMS=cpu`` for a host-only check).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from any cwd without installing
+    sys.path.insert(0, REPO)
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9, Geometry
+from distributed_sudoku_solver_tpu.obs import ordertrace
+from distributed_sudoku_solver_tpu.ops import ordering
+
+
+def hard_corpus(
+    n_generated: int, n_clues: int = 24, seed0: int = 0, unique: bool = True
+):
+    """The hard-tail corpus: the three benchmark killer boards plus
+    ``n_generated`` generated boards at ``n_clues``.  ``unique=False``
+    skips the uniqueness check while carving — under-constrained boards
+    branch much deeper, which is what the example recorder wants."""
+    from distributed_sudoku_solver_tpu.utils.puzzles import HARD_9, make_puzzle
+
+    boards = [np.asarray(b) for b in HARD_9]
+    for seed in range(seed0, seed0 + n_generated):
+        boards.append(
+            np.asarray(
+                make_puzzle(SUDOKU_9, seed=seed, n_clues=n_clues, unique=unique)
+            )
+        )
+    return boards
+
+
+def cmd_record(args) -> None:
+    geom = SUDOKU_9
+    grids = []
+    if args.trace:
+        for ev in ordertrace.read_events(args.trace):
+            if ev.get("kind") != "grid":
+                continue
+            n = int(ev["n"])
+            flat = [int(ch) for ch in ev["grid"]]
+            grids.append(np.asarray(flat, dtype=np.int64).reshape(n, n))
+        print(f"trace {args.trace}: {len(grids)} recorded grids")
+    if args.corpus:
+        grids.extend(
+            hard_corpus(args.corpus, args.clues, unique=not args.no_unique)
+        )
+    if not grids:
+        sys.exit("record: nothing to replay (pass --corpus N and/or --trace FILE)")
+    n_examples = 0
+    with open(args.out, "w", encoding="utf-8") as fh:
+        for i, g in enumerate(grids):
+            examples, nodes = ordering.record_branch_examples(
+                g, geom, max_nodes=args.max_nodes
+            )
+            for ex in examples:
+                fh.write(json.dumps(ex, sort_keys=True) + "\n")
+            n_examples += len(examples)
+            print(f"  board {i}: {len(examples)} examples, {nodes} nodes")
+    print(f"wrote {n_examples} examples -> {args.out}")
+
+
+def _load_examples(path: str):
+    xs, ys = [], []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            ex = json.loads(line)
+            xs.append(ex["features"])
+            ys.append(np.log2(1.0 + float(ex["nodes"])))
+    return np.asarray(xs, np.float32), np.asarray(ys, np.float32)
+
+
+def cmd_train(args) -> None:
+    x, y = _load_examples(args.examples)
+    n, n_feat = x.shape
+    hidden = args.hidden
+    rng = np.random.default_rng(args.seed)
+    w1 = rng.normal(0, 0.5, size=(n_feat, hidden)).astype(np.float32)
+    b1 = np.zeros(hidden, np.float32)
+    w2 = rng.normal(0, 0.5, size=hidden).astype(np.float32)
+    b2 = np.float32(y.mean())
+    params = [w1, b1, w2, b2]
+    # Adam state (numpy, no deps): one moment pair per tensor.
+    ms = [np.zeros_like(p) for p in params]
+    vs = [np.zeros_like(p) for p in params]
+    beta1, beta2, eps, lr = 0.9, 0.999, 1e-8, args.lr
+    steps = 0
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        losses = []
+        for lo in range(0, n, args.batch):
+            idx = perm[lo : lo + args.batch]
+            xb, yb = x[idx], y[idx]
+            pre = xb @ params[0] + params[1]
+            h = np.maximum(pre, 0.0)
+            pred = h @ params[2] + params[3]
+            err = pred - yb
+            losses.append(float((err**2).mean()))
+            # Backprop by hand: MSE -> linear -> relu -> linear.
+            g_pred = 2.0 * err / len(idx)
+            g_w2 = h.T @ g_pred
+            g_b2 = g_pred.sum()
+            g_h = np.outer(g_pred, params[2]) * (pre > 0)
+            g_w1 = xb.T @ g_h
+            g_b1 = g_h.sum(axis=0)
+            grads = [g_w1, g_b1, g_w2, g_b2]
+            steps += 1
+            for i, g in enumerate(grads):
+                ms[i] = beta1 * ms[i] + (1 - beta1) * g
+                vs[i] = beta2 * vs[i] + (1 - beta2) * np.square(g)
+                m_hat = ms[i] / (1 - beta1**steps)
+                v_hat = vs[i] / (1 - beta2**steps)
+                params[i] = params[i] - lr * m_hat / (np.sqrt(v_hat) + eps)
+        if epoch % max(1, args.epochs // 10) == 0 or epoch == args.epochs - 1:
+            print(f"epoch {epoch}: mse={np.mean(losses):.4f} (n={n})")
+    w1, b1, w2, b2 = params
+    doc = {
+        "schema": "dsst-ordering-mlp/1",
+        "w1": [[float(v) for v in row] for row in w1],
+        "b1": [float(v) for v in b1],
+        "w2": [float(v) for v in w2],
+        "b2": float(b2),
+        "meta": {
+            "examples": int(n),
+            "hidden": hidden,
+            "epochs": args.epochs,
+            "final_mse": round(float(np.mean(losses)), 4),
+        },
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    print(f"weights -> {args.out}")
+
+
+def cmd_fit_threshold(args) -> None:
+    from distributed_sudoku_solver_tpu.serving.frontdoor.learn import (
+        learned_easy_score,
+    )
+
+    threshold, report = learned_easy_score(
+        args.trace, default=args.default, min_samples=args.min_samples
+    )
+    print(json.dumps({"easy_score": threshold, **report}, indent=1))
+
+
+def cmd_eval(args) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+
+    geom = SUDOKU_9
+    boards = hard_corpus(args.corpus, args.clues)
+    n = geom.n
+
+    def check_solution(g, s):
+        for i in range(n):
+            assert sorted(s[i, :]) == list(range(1, n + 1)), "row"
+            assert sorted(s[:, i]) == list(range(1, n + 1)), "col"
+        assert ((g == 0) | (g == s)).all(), "clues"
+
+    # Per-JOB flights (one board per solve): the serving regime the heads
+    # target — latency-mode and front-door device jobs fly one board each,
+    # and the per-job nodes counter in the status word is the win being
+    # claimed.  A wide shared batch would hide the ordering win behind
+    # lane-parallel speculative expansion.
+    heads = ("minrem", "head:minrem", "head:cw-slack", "head:mlp")
+    out = {"corpus": len(boards), "config": {
+        "lanes": args.lanes, "stack_slots": args.stack_slots,
+        "step_impl": args.step_impl, "per_job": True,
+    }, "heads": {}}
+    base = None
+    for rule in heads:
+        cfg = SolverConfig(
+            lanes=args.lanes, stack_slots=args.stack_slots,
+            branch=rule, step_impl=args.step_impl,
+        )
+        verdicts, nodes_total, searched = [], 0, 0
+        for g in boards:
+            res = solve_batch(jnp.asarray(np.asarray(g)[None]), geom, cfg)
+            solved = bool(res.solved[0])
+            unsat = bool(res.unsat[0])
+            nodes = int(res.nodes[0])
+            if solved:
+                check_solution(g, np.asarray(res.solution[0]))
+            if unsat:
+                # Unsat verdicts must survive the oracle: exact
+                # enumeration over the same board must find zero.
+                cnt = solve_batch(
+                    jnp.asarray(np.asarray(g)[None]), geom,
+                    dataclasses.replace(cfg, count_all=True),
+                )
+                assert int(cnt.sol_count[0]) == 0, \
+                    "unsat verdict contradicted by count_all"
+            verdicts.append((solved, unsat))
+            nodes_total += nodes
+            searched += 1 if nodes > 0 else 0
+        row = {
+            "solved": sum(1 for s, _ in verdicts if s),
+            "unsat": sum(1 for _, u in verdicts if u),
+            "searched": searched,
+            "nodes": nodes_total,
+        }
+        if base is None:
+            base = (row, verdicts)
+            row["nodes_vs_minrem"] = 1.0
+        else:
+            assert verdicts == base[1], f"{rule}: verdicts differ from minrem"
+            row["nodes_vs_minrem"] = round(row["nodes"] / base[0]["nodes"], 4)
+        out["heads"][rule] = row
+        print(
+            f"{rule:<16} solved={row['solved']:>3} searched={row['searched']:>3} "
+            f"nodes={row['nodes']:>6}  vs minrem x{row['nodes_vs_minrem']}"
+        )
+    if args.out_json:
+        with open(args.out_json, "w", encoding="utf-8") as fh:
+            json.dump(out, fh, indent=1)
+            fh.write("\n")
+        print(f"eval artifact -> {args.out_json}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rec = sub.add_parser("record", help="replay solves, journal branch examples")
+    rec.add_argument("--corpus", type=int, default=24,
+                     help="generated hard boards to include (0 = none)")
+    rec.add_argument("--clues", type=int, default=24)
+    rec.add_argument("--trace", default=None,
+                     help="ordering-trace JSONL with sampled grid events")
+    rec.add_argument("--no-unique", action="store_true",
+                     help="skip the uniqueness check while carving the "
+                     "generated boards: under-constrained boards branch "
+                     "deeper and yield far more examples")
+    rec.add_argument("--max-nodes", type=int, default=50_000)
+    rec.add_argument("--out", default="ordering_examples.jsonl")
+    rec.set_defaults(fn=cmd_record)
+
+    tr = sub.add_parser("train", help="fit the mlp head on recorded examples")
+    tr.add_argument("--examples", default="ordering_examples.jsonl")
+    tr.add_argument("--hidden", type=int, default=8)
+    tr.add_argument("--epochs", type=int, default=200)
+    tr.add_argument("--batch", type=int, default=256)
+    tr.add_argument("--lr", type=float, default=3e-3)
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--out", default="ordering_weights.json")
+    tr.set_defaults(fn=cmd_train)
+
+    ft = sub.add_parser("fit-threshold",
+                        help="learn the front door easy_score from a trace")
+    ft.add_argument("--trace", required=True)
+    ft.add_argument("--default", type=int, default=64)
+    ft.add_argument("--min-samples", type=int, default=8)
+    ft.set_defaults(fn=cmd_fit_threshold)
+
+    ev = sub.add_parser("eval", help="head A/B on the hard-tail corpus")
+    ev.add_argument("--corpus", type=int, default=24)
+    ev.add_argument("--clues", type=int, default=24)
+    ev.add_argument("--lanes", type=int, default=8)
+    ev.add_argument("--stack-slots", type=int, default=64)
+    ev.add_argument("--step-impl", default="xla", choices=("xla", "fused"))
+    ev.add_argument("--out-json", default=None)
+    ev.set_defaults(fn=cmd_eval)
+
+    args = ap.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

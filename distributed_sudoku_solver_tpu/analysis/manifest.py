@@ -352,6 +352,21 @@ JAXCK_CANON = {
             "lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64,
             "steal_gang": 2, "protect_home_lanes": True,
         },
+        # Scored branch ordering (ISSUE 19): the head-enabled advance
+        # programs are DIFFERENT jaxprs (the head's score graph replaces
+        # the packed popcount key), so they carry their own canon configs
+        # and their own goldens — the default ``config``/``config_fused``
+        # entries above must stay byte-identical to pre-head rounds.
+        # cw-slack is the canon head: pure VPU, deterministic, no weights
+        # file to load at trace time.
+        "config_head": {
+            "lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64,
+            "branch": "head:cw-slack",
+        },
+        "config_fused_head": {
+            "lanes": 8, "min_lanes": 8, "stack_slots": 4, "max_steps": 64,
+            "step_impl": "fused", "fused_steps": 2, "branch": "head:cw-slack",
+        },
     },
 }
 
@@ -530,6 +545,26 @@ ENTRY_POINTS = (
         fn="distributed_sudoku_solver_tpu.ops.pallas_step:advance_frontier_fused_status",
         args=(("frontier", "config_fused"), ("array", (), "int32")),
         static={"geom": "geom", "config": "config_fused"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    # Scored branch ordering (ISSUE 19): the SAME advance programs traced
+    # under the head:cw-slack canon configs.  The branch head's score
+    # graph is part of the jaxpr, so head drift gets its own golden pair
+    # here instead of hiding inside (or perturbing) the default entries
+    # above.  ``@head`` in the name is a golden-key suffix, not a module
+    # path — ``fn`` is what resolves.
+    dict(
+        name="utils.checkpoint.advance_frontier@head", display="advance_head",
+        fn="distributed_sudoku_solver_tpu.utils.checkpoint:advance_frontier",
+        args=(("frontier", "config_head"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config_head"},
+        donate=(0,), donation="threads", hot=True,
+    ),
+    dict(
+        name="ops.pallas_step.advance_frontier_fused@head", display="advance_fused_head",
+        fn="distributed_sudoku_solver_tpu.ops.pallas_step:advance_frontier_fused",
+        args=(("frontier", "config_fused_head"), ("array", (), "int32")),
+        static={"geom": "geom", "config": "config_fused_head"},
         donate=(0,), donation="threads", hot=True,
     ),
     # parallel/ — the sharded drivers (bulk tier; no donation today, but
@@ -728,6 +763,7 @@ LOCK_RANKS = {
     "obs.critpath": 62,       # obs/critpath.py CritPathMonitor._lock
     "obs.trace": 64,          # obs/trace.py TraceRecorder._lock
     "obs.hist": 66,           # obs/hist.py LatencyHistogram._lock
+    "obs.ordertrace": 67,     # obs/ordertrace.py OrderTraceRecorder._lock
     "obs.minest": 68,         # obs/hist.py MinEstimator._lock
     "utils.statwindow": 69,   # utils/profiling.py StatWindow._lock (pure leaf)
     "cluster.simnet": 72,     # cluster/simnet.py SimNet._cond

@@ -54,10 +54,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument(
         "--branch",
-        choices=("minrem", "first", "mixed", "minrem-desc"),
+        choices=(
+            "minrem", "first", "mixed", "minrem-desc",
+            "head:minrem", "head:cw-slack", "head:mlp",
+        ),
         default="minrem",
         help="branch heuristic (first = reference-order bit-exact DFS; "
-        "minrem-desc = MRV with descending digit order, the portfolio mirror)",
+        "minrem-desc = MRV with descending digit order, the portfolio "
+        "mirror; head:* = scored branch heads, ops/ordering.py — "
+        "head:minrem is bit-exact to minrem, head:cw-slack weights MRV "
+        "by peer-unit slack, head:mlp is the trained prior from "
+        "benchmarks/train_ordering.py)",
     )
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument(
@@ -137,6 +144,37 @@ def build_parser() -> argparse.ArgumentParser:
         "propagation branching slack (sum of candidates-1 over undecided "
         "cells) is at or below this race the native DFS instead of "
         "paying a device dispatch",
+    )
+    ap.add_argument(
+        "--learn-easy-score",
+        type=str,
+        default=None,
+        metavar="TRACE",
+        help="learn the --easy-score threshold from a recorded ordering "
+        "trace (obs/ordertrace.py JSONL, recorded with --ordering-trace) "
+        "instead of the fixed default: the route/wall outcomes in the "
+        "trace pick the score cut that minimizes estimated total wall "
+        "(serving/frontdoor/learn.py).  Falls back to --easy-score when "
+        "the trace is too thin to price both routes",
+    )
+    ap.add_argument(
+        "--ordering-trace",
+        type=str,
+        default=None,
+        metavar="PATH",
+        help="journal route outcomes + sampled grids to this JSONL file "
+        "(obs/ordertrace.py) — the training input for "
+        "benchmarks/train_ordering.py (the mlp branch head and the "
+        "learned easy-score threshold).  Off by default: zero overhead "
+        "when unset",
+    )
+    ap.add_argument(
+        "--ordering-sample",
+        type=int,
+        default=8,
+        metavar="K",
+        help="with --ordering-trace, record every K-th resolved grid as "
+        "a branch-example source (1 = every grid)",
     )
     ap.add_argument(
         "--fault-retries",
@@ -366,9 +404,25 @@ def make_engine(args) -> SolverEngine:
             FrontDoorConfig,
         )
 
+        easy_score = args.easy_score
+        if args.learn_easy_score:
+            # The learned routing threshold (ROADMAP #4 follow-through):
+            # replayed route/wall outcomes pick the cut; a too-thin trace
+            # keeps the flag default (the learner says why).
+            from distributed_sudoku_solver_tpu.serving.frontdoor.learn import (
+                learned_easy_score,
+            )
+
+            easy_score, report = learned_easy_score(
+                args.learn_easy_score, default=args.easy_score
+            )
+            print(
+                f"easy-score: {easy_score} "
+                f"({'learned from ' + args.learn_easy_score if report.get('fitted') else report.get('reason', 'default')})"
+            )
         frontdoor = FrontDoorConfig(
             cache_entries=args.cache_entries,
-            easy_score=args.easy_score,
+            easy_score=easy_score,
         )
     megastep = None
     if solve_fn is None:
@@ -511,6 +565,20 @@ def main(argv=None) -> None:
         critpath_mod.install(
             critpath_mod.CritPathMonitor(
                 slow_ms=args.critpath_slow_ms or None
+            )
+        )
+    if args.ordering_trace:
+        # The opt-in ordering journal (obs/ordertrace.py): route outcomes
+        # + sampled grids, the raw material for the offline branch-head
+        # and threshold trainers.  Installed before the engine boots so
+        # the warmup solves are journaled too.
+        from distributed_sudoku_solver_tpu.obs import (
+            ordertrace as ordertrace_mod,
+        )
+
+        ordertrace_mod.install(
+            ordertrace_mod.OrderTraceRecorder(
+                args.ordering_trace, sample_grids=args.ordering_sample
             )
         )
     if not args.no_compile_watch:

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
+from distributed_sudoku_solver_tpu.ops import ordering
 from distributed_sudoku_solver_tpu.ops.bitmask import highest_bit, lowest_bit, popcount
 from distributed_sudoku_solver_tpu.ops.propagate import board_status, propagate
 
@@ -42,8 +43,9 @@ class SudokuCSP:
     rules: str = "basic"
 
     def __post_init__(self) -> None:
-        if self.branch_rule not in ("minrem", "first", "mixed", "minrem-desc"):
-            raise ValueError(f"unknown branch rule {self.branch_rule!r}")
+        # Shared spelling with SolverConfig: legacy rules plus the scored
+        # branch heads ('head:<name>', ops/ordering.py — ROADMAP #4).
+        ordering.validate_branch(self.branch_rule)
         if self.propagator not in ("xla", "pallas", "slices"):
             raise ValueError(f"unknown propagator {self.propagator!r}")
         from distributed_sudoku_solver_tpu.ops.propagate import RULE_TIERS
@@ -133,6 +135,17 @@ class SudokuCSP:
         lanes = cand.shape[0]
         pc = popcount(cand).reshape(lanes, n * n).astype(jnp.int32)
         cell_idx = jnp.arange(n * n, dtype=jnp.int32)
+        if ordering.is_head_rule(self.branch_rule):
+            # Scored branch head (ops/ordering.py): f32 score -> the same
+            # packed argmin key shape the legacy rules select on.  A
+            # Python-level static branch — the legacy jaxprs below stay
+            # byte-identical (jaxck goldens pass un-blessed).
+            head = ordering.get_head(self.branch_rule)
+            score = head.score_lanes(cand, self.geom)
+            key = ordering.pack_key(score, pc > 1, cell_idx, n, head.quant)
+            chosen = jnp.argmin(key, axis=-1)
+            onehot = cell_idx[None, :] == chosen[:, None]
+            return onehot.reshape(lanes, n, n)
         minrem_key = jnp.where(pc > 1, pc * (n * n) + cell_idx, jnp.int32(2**30))
         first_key = jnp.where(pc > 1, cell_idx, jnp.int32(2**30))
         if self.branch_rule in ("minrem", "minrem-desc"):

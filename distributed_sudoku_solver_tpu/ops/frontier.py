@@ -149,6 +149,13 @@ class SolverConfig:
     #   the next attach.  No-op when steal_gang == 0.
 
     def __post_init__(self) -> None:
+        # Config-time branch validation (ISSUE 19 satellite): a typo'd
+        # rule or unknown scoring head used to surface only when the
+        # problem object was built mid-solve; fail at construction, where
+        # the CLI/engine/HTTP boundary can still answer 4xx.
+        from distributed_sudoku_solver_tpu.ops import ordering
+
+        ordering.validate_branch(self.branch)
         if self.branch_k not in (2, 3):
             raise ValueError(f"branch_k must be 2 or 3, got {self.branch_k}")
         if self.step_impl not in ("xla", "fused"):

@@ -1,0 +1,524 @@
+"""Branch-ordering heads: pluggable, batched scored branch selection.
+
+ROADMAP #4 (ISSUE 19).  Every tier of the stack used to dispatch the same
+hardwired MRV key; this module makes the *branch-cell choice* a first-class
+scoring head.  A head is a frozen, hashable dataclass (jit-static, like
+``SudokuCSP`` itself) exposing one seam in two layouts:
+
+* ``score_lanes(cand, geom) -> f32[L, cells]`` — the lane-first XLA batch
+  site (``models/sudoku.py:_branch_cell_onehot``).
+* ``score_full(cand, geom, unit_sum) -> f32[n, n, T]`` — the boards-last
+  Mosaic site (``ops/pallas_step.py:branch_onehot_full``).  ``unit_sum`` is
+  injected by the kernel (its cell-uniform ``_unit_full`` reduction) so the
+  head never needs pallas-internal helpers; everything a head computes here
+  must stay Mosaic-legal (pure elementwise VPU ops + the injected
+  reductions — no gather/scatter, no bool carries, Python-int constants).
+
+Lower score = branch here.  :func:`pack_key` turns a score into the packed
+int32 argmin key the engine already selects on (``q * n^2 + cell_index``),
+which keeps tie-breaks deterministic (lowest cell wins) and makes the
+``minrem`` head *bit-exact* to the legacy key: score = popcount, quant = 1
+reproduces ``pc * n^2 + cell`` integer-for-integer, so the default search
+tree is untouched.
+
+Heads ship in three flavors (selected via ``SolverConfig.branch =
+'head:<name>'``):
+
+* ``minrem``  — the legacy MRV rule re-expressed as a head (bit-exact).
+* ``cw-slack`` — constrainedness-weighted MRV: candidate count primary,
+  peer-unit slack (sum of ``candidates - 1`` over the cell's row/col/box
+  peers — the in-graph twin of ``probe_propagate``'s branching-slack
+  score) as the tie-break, *tightest neighborhood first*.  Pure VPU ops.
+* ``mlp``     — a tiny learned prior: one hidden layer over the cell's
+  bitmask-neighborhood features, f32 matmul on the MXU lane-side, unrolled
+  FMAs kernel-side.  Weights train offline (``benchmarks/train_ordering.py``)
+  from per-branch (state, chosen-cell, subtree-nodes) examples recorded by
+  the opt-in ordering trace (``obs/ordertrace.py``); they load via stdlib
+  json only — importing this module never imports jax.
+
+Correctness contract: the default ``minrem`` path stays byte-identical
+(head dispatch is a Python-level static branch); non-default heads relax
+bit-exactness to **verdict-equality** — solutions oracle-checked, unsat
+cross-checked by ``count_all`` (tests/test_ordering.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+from typing import Callable, Optional, Tuple
+
+#: Decided/invalid cells take this key: any live score packs strictly
+#: smaller, so argmin never lands on a decided cell while work remains.
+#: Python int on purpose — pallas rejects captured jnp scalars.
+BIG = 2**30
+
+#: The shipped heads, in registry order.  ``head:<name>`` spellings of
+#: these are valid ``SolverConfig.branch`` / ``SudokuCSP.branch_rule``
+#: values; anything else is a config-time error.
+HEAD_NAMES = ("minrem", "cw-slack", "mlp")
+
+#: Legacy (non-head) branch rules, shared with SolverConfig/SudokuCSP
+#: validation so the accepted set has one spelling.
+LEGACY_RULES = ("minrem", "first", "mixed", "minrem-desc")
+
+_WEIGHTS_FILE = os.path.join(os.path.dirname(__file__), "ordering_weights.json")
+
+
+def is_head_rule(rule: str) -> bool:
+    return isinstance(rule, str) and rule.startswith("head:")
+
+
+def validate_branch(rule: str) -> None:
+    """Config-time validation of a branch rule string (legacy or head).
+
+    Raises ``ValueError`` on anything the engine would only reject at
+    solve/trace time otherwise — ``SolverConfig.__post_init__`` and
+    ``SudokuCSP.__post_init__`` both route through here (satellite:
+    surface incompatibilities at config time, not mid-flight)."""
+    if rule in LEGACY_RULES:
+        return
+    if is_head_rule(rule):
+        name = rule[len("head:"):]
+        if name in HEAD_NAMES:
+            return
+        raise ValueError(
+            f"unknown branch head {name!r} (known: {', '.join(HEAD_NAMES)})"
+        )
+    raise ValueError(
+        f"unknown branch rule {rule!r} (legacy: {', '.join(LEGACY_RULES)}; "
+        f"heads: {', '.join('head:' + h for h in HEAD_NAMES)})"
+    )
+
+
+def _qmax(n: int) -> int:
+    # Largest quantized score that still packs under BIG with the cell
+    # index in the low bits.
+    return BIG // (n * n) - 1
+
+
+def pack_key(score, und, cell, n: int, quant: int):
+    """f32 score -> packed int32 argmin key (``q * n^2 + cell``).
+
+    ``und`` masks decided cells to :data:`BIG`; ``quant`` scales the score
+    before round-to-nearest (a head with lexicographic structure picks a
+    power of two so component boundaries stay exact in f32).  Works in
+    both layouts — ``score``/``und``/``cell`` just have to agree."""
+    import jax.numpy as jnp
+
+    q = jnp.clip(jnp.round(score * quant), 0, _qmax(n)).astype(jnp.int32)
+    return jnp.where(und, q * (n * n) + cell, jnp.int32(BIG))
+
+
+def _unit_sums_lanes(x, geom):
+    """Row/col/box sums of ``x`` [L, n, n], each broadcast back to cells."""
+    import jax.numpy as jnp
+
+    vb, hb, bh, bw = geom.n_vboxes, geom.n_hboxes, geom.box_h, geom.box_w
+    lanes = x.shape[0]
+    row = jnp.sum(x, axis=2, keepdims=True) + jnp.zeros_like(x)
+    col = jnp.sum(x, axis=1, keepdims=True) + jnp.zeros_like(x)
+    boxes = x.reshape(lanes, vb, bh, hb, bw)
+    box = jnp.sum(boxes, axis=(2, 4), keepdims=True) + jnp.zeros_like(boxes)
+    return row, col, box.reshape(lanes, geom.n, geom.n)
+
+
+# -- the heads -----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MinremHead:
+    """The legacy MRV rule as a head: score = candidate count.
+
+    quant = 1 makes ``pack_key`` reproduce the historical
+    ``pc * n^2 + cell`` key integer-for-integer — selection, search tree,
+    and node counts are bit-identical to ``branch='minrem'``."""
+
+    name: str = "minrem"
+    quant: int = 1
+
+    def score_lanes(self, cand, geom):
+        import jax
+        import jax.numpy as jnp
+
+        lanes = cand.shape[0]
+        pc = jax.lax.population_count(cand).astype(jnp.int32)
+        return pc.reshape(lanes, geom.n * geom.n).astype(jnp.float32)
+
+    def score_full(self, cand, geom, unit_sum):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.lax.population_count(cand).astype(jnp.int32).astype(jnp.float32)
+
+
+#: Peer-slack saturation: one less than the cw-slack quant so the slack
+#: tie-break can never carry into the candidate-count component.
+_SLACK_CAP = 2047
+
+
+@dataclasses.dataclass(frozen=True)
+class CwSlackHead:
+    """Constrainedness-weighted MRV: fewest candidates first, tightest
+    peer neighborhood as the tie-break.
+
+    The tie-break term is the branching slack of the cell's peers — the
+    sum of ``candidates - 1`` over undecided cells sharing its row, column
+    or box (each peer counted once per shared unit), exactly the quantity
+    ``probe_propagate`` scores whole boards by at the front door.  A cell
+    whose neighborhood holds little slack sits in a near-decided region:
+    guessing there propagates further and refutes earlier, which is what
+    shrinks the tree on the hard tail.  Lexicographic packing:
+    ``score = pc + min(peer_slack, 2047) / 2048`` with quant 2048 — both
+    components exact in f32, candidate count always dominant."""
+
+    name: str = "cw-slack"
+    quant: int = 2048
+
+    def _score(self, pc, row, col, box):
+        # Shared arithmetic for both layouts: inputs are the int32
+        # popcount map and its three unit sums of (pc - 1 over undecided).
+        import jax.numpy as jnp
+
+        excess = jnp.where(pc > 1, pc - 1, 0)
+        peer = row + col + box - 3 * excess
+        peer = jnp.minimum(peer, _SLACK_CAP).astype(jnp.float32)
+        return pc.astype(jnp.float32) + peer * (1.0 / (_SLACK_CAP + 1))
+
+    def score_lanes(self, cand, geom):
+        import jax
+        import jax.numpy as jnp
+
+        lanes = cand.shape[0]
+        pc = jax.lax.population_count(cand).astype(jnp.int32)
+        excess = jnp.where(pc > 1, pc - 1, 0)
+        row, col, box = _unit_sums_lanes(excess, geom)
+        score = self._score(pc, row, col, box)
+        return score.reshape(lanes, geom.n * geom.n)
+
+    def score_full(self, cand, geom, unit_sum):
+        import jax
+        import jax.numpy as jnp
+
+        pc = jax.lax.population_count(cand).astype(jnp.int32)
+        excess = jnp.where(pc > 1, pc - 1, 0)
+        row, col, box = unit_sum(excess)
+        return self._score(pc, row, col, box)
+
+
+def _cell_features(pc, excess, row_e, col_e, box_e, row_u, col_u, box_u, n):
+    """The 7 per-cell feature maps the MLP scores, in fixed order.
+
+    Shared by both in-graph layouts AND the numpy recorder
+    (:func:`features_np`) — train/serve skew here silently mis-ranks
+    every branch, so there is exactly one definition.  All features are
+    ~unit-scaled so offline training needs no normalization state."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    inv_n = 1.0 / n
+    inv_n2 = 1.0 / (n * n)
+    return (
+        pc.astype(f32) * inv_n,                     # own candidate count
+        (row_e - excess).astype(f32) * inv_n2,      # row peer slack
+        (col_e - excess).astype(f32) * inv_n2,      # col peer slack
+        (box_e - excess).astype(f32) * inv_n2,      # box peer slack
+        row_u.astype(f32) * inv_n,                  # undecided row peers
+        col_u.astype(f32) * inv_n,
+        box_u.astype(f32) * inv_n,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MlpHead:
+    """Tiny learned branch prior: one hidden layer over the cell's
+    bitmask-neighborhood features, trained to predict log2(subtree nodes).
+
+    Weights are tuples of Python floats (hashable — the head is jit-static
+    like the problem object that names it) produced by
+    ``benchmarks/train_ordering.py`` and loaded via stdlib json.  Lane-side
+    the layer runs as one f32 matmul (``preferred_element_type`` pins the
+    MXU accumulate); kernel-side the same arithmetic unrolls into
+    per-feature FMAs so the boards-last layout stays Mosaic-legal.  The
+    raw score is shifted/clipped into [0, 16) by :func:`pack_key`'s clamp;
+    quant 4096 keeps ~12 bits of ranking resolution."""
+
+    w1: Tuple[Tuple[float, ...], ...]  # [F][H]
+    b1: Tuple[float, ...]              # [H]
+    w2: Tuple[float, ...]              # [H]
+    b2: float
+    name: str = "mlp"
+    quant: int = 4096
+
+    def _features(self, cand, geom, unit_sum):
+        import jax
+        import jax.numpy as jnp
+
+        pc = jax.lax.population_count(cand).astype(jnp.int32)
+        und = (pc > 1).astype(jnp.int32)
+        excess = jnp.where(pc > 1, pc - 1, 0)
+        row_e, col_e, box_e = unit_sum(excess)
+        row_u, col_u, box_u = unit_sum(und)
+        return _cell_features(
+            pc, excess, row_e, col_e, box_e,
+            row_u - und, col_u - und, box_u - und, geom.n,
+        )
+
+    def score_lanes(self, cand, geom):
+        import jax.numpy as jnp
+
+        lanes = cand.shape[0]
+        feats = self._features(
+            cand, geom, unit_sum=lambda x: _unit_sums_lanes(x, geom)
+        )
+        x = jnp.stack(
+            [f.reshape(lanes, geom.n * geom.n) for f in feats], axis=-1
+        )
+        w1 = jnp.asarray(self.w1, dtype=jnp.float32)
+        h = jnp.maximum(
+            jnp.dot(x, w1, preferred_element_type=jnp.float32)
+            + jnp.asarray(self.b1, dtype=jnp.float32),
+            0.0,
+        )
+        out = jnp.dot(
+            h, jnp.asarray(self.w2, dtype=jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) + self.b2
+        return out + 8.0  # shift into pack_key's non-negative clamp range
+
+    def score_full(self, cand, geom, unit_sum):
+        import jax.numpy as jnp
+
+        feats = self._features(cand, geom, unit_sum)
+        hidden = []
+        for j in range(len(self.b1)):
+            acc = feats[0] * self.w1[0][j]
+            for f in range(1, len(self.w1)):
+                acc = acc + feats[f] * self.w1[f][j]
+            hidden.append(jnp.maximum(acc + self.b1[j], 0.0))
+        out = hidden[0] * self.w2[0]
+        for j in range(1, len(hidden)):
+            out = out + hidden[j] * self.w2[j]
+        return out + (self.b2 + 8.0)
+
+
+# -- registry ------------------------------------------------------------------
+
+
+def _to_tuples(rows):
+    return tuple(tuple(float(v) for v in row) for row in rows)
+
+
+def load_mlp_weights(path: Optional[str] = None) -> MlpHead:
+    """Build the mlp head from a weights json (stdlib only — no jax).
+
+    Schema (``benchmarks/train_ordering.py train`` emits it)::
+
+        {"schema": "dsst-ordering-mlp/1",
+         "w1": [[..H floats..] x F], "b1": [..H..], "w2": [..H..], "b2": f}
+    """
+    with open(path or _WEIGHTS_FILE) as fh:
+        data = json.load(fh)
+    if data.get("schema") != "dsst-ordering-mlp/1":
+        raise ValueError(f"unknown ordering weights schema {data.get('schema')!r}")
+    return MlpHead(
+        w1=_to_tuples(data["w1"]),
+        b1=tuple(float(v) for v in data["b1"]),
+        w2=tuple(float(v) for v in data["w2"]),
+        b2=float(data["b2"]),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def get_head(rule: str):
+    """Resolve ``'head:<name>'`` (or a bare head name) to THE head object.
+
+    Cached so every ``sudoku_csp(geom, config)`` call sees the identical
+    hashable instance — jit caches keyed on the problem object never fork
+    across lookups.  The mlp head resolves its committed default weights
+    here; a custom weights file is a different head object by construction
+    (build it with :func:`load_mlp_weights` and pass it explicitly)."""
+    name = rule[len("head:"):] if is_head_rule(rule) else rule
+    if name == "minrem":
+        return MinremHead()
+    if name == "cw-slack":
+        return CwSlackHead()
+    if name == "mlp":
+        return load_mlp_weights()
+    raise ValueError(
+        f"unknown branch head {name!r} (known: {', '.join(HEAD_NAMES)})"
+    )
+
+
+# -- host-side mirror: numpy propagation + the branch-example recorder ---------
+#
+# The learned head trains on per-branch (state, chosen-cell, subtree-nodes)
+# examples.  The device kernels cannot journal per-branch data without
+# paying a host sync per node, so examples come from a host replay that
+# mirrors the kernel's semantics: bitmask states, elimination +
+# hidden-singles propagation, MRV/ascending-digit DFS.  numpy only — this
+# path must run wherever the opt-in trace ran, jax-free.
+
+
+def _np_propagate(m, geom, max_sweeps: int = 64):
+    """Eliminations + hidden singles to a fixpoint on a bitmask board.
+
+    Returns ``(m, status)`` with status 'solved' | 'unsat' | 'open' —
+    the host twin of ``ops/propagate.py`` at the basic rule tier (the
+    recorder's teacher solves; head training never needs the extended
+    tiers, branching statistics dominate)."""
+    import numpy as np
+
+    n = geom.n
+    vb, hb, bh, bw = geom.n_vboxes, geom.n_hboxes, geom.box_h, geom.box_w
+    digits = np.arange(n, dtype=np.int64)
+    weights = np.int64(1) << digits
+
+    def popcounts(mm):
+        return ((mm[..., None] >> digits) & 1).sum(-1)
+
+    for _ in range(max_sweeps):
+        prev = m
+        pc = popcounts(m)
+        if (m == 0).any():
+            return m, "unsat"
+        singles = np.where(pc == 1, m, 0)
+        sb = (singles[..., None] >> digits) & 1
+        if (sb.sum(axis=1) > 1).any() or (sb.sum(axis=0) > 1).any():
+            return m, "unsat"
+        if (sb.reshape(vb, bh, hb, bw, n).sum(axis=(1, 3)) > 1).any():
+            return m, "unsat"
+        row_or = np.bitwise_or.reduce(singles, axis=1)
+        col_or = np.bitwise_or.reduce(singles, axis=0)
+        box_or = np.bitwise_or.reduce(
+            np.bitwise_or.reduce(singles.reshape(vb, bh, hb, bw), axis=3),
+            axis=1,
+        )
+        box_exp = np.repeat(np.repeat(box_or, bh, axis=0), bw, axis=1)
+        m = m & ~((row_or[:, None] | col_or[None, :] | box_exp) & ~singles)
+        if (m == 0).any():
+            return m, "unsat"
+        bits = (m[..., None] >> digits) & 1
+        row_u = bits.sum(axis=1) == 1
+        col_u = bits.sum(axis=0) == 1
+        box_u = bits.reshape(vb, bh, hb, bw, n).sum(axis=(1, 3)) == 1
+        box_u_exp = np.repeat(np.repeat(box_u, bh, axis=0), bw, axis=1)
+        uniq = row_u[:, None, :] | col_u[None, :, :] | box_u_exp
+        hid = m & (uniq * weights).sum(-1)
+        if (popcounts(hid) > 1).any():
+            return m, "unsat"
+        m = np.where(hid != 0, hid, m)
+        if np.array_equal(m, prev):
+            break
+    pc = popcounts(m)
+    if (pc == 1).all():
+        return m, "solved"
+    return m, "open"
+
+
+def features_np(m, geom):
+    """f32[n, n, 7] — the numpy twin of the in-graph feature maps.
+
+    MUST rank identically to :func:`_cell_features` (pinned by
+    tests/test_ordering.py's parity test): training reads these, serving
+    reads those."""
+    import numpy as np
+
+    n = geom.n
+    vb, hb, bh, bw = geom.n_vboxes, geom.n_hboxes, geom.box_h, geom.box_w
+    digits = np.arange(n, dtype=np.int64)
+    pc = ((m[..., None] >> digits) & 1).sum(-1)
+    und = (pc > 1).astype(np.int64)
+    excess = np.where(pc > 1, pc - 1, 0)
+
+    def unit(x):
+        row = np.repeat(x.sum(axis=1, keepdims=True), n, axis=1)
+        col = np.repeat(x.sum(axis=0, keepdims=True), n, axis=0)
+        box = x.reshape(vb, bh, hb, bw).sum(axis=(1, 3))
+        box = np.repeat(np.repeat(box, bh, axis=0), bw, axis=1)
+        return row, col, box
+
+    row_e, col_e, box_e = unit(excess)
+    row_u, col_u, box_u = unit(und)
+    feats = np.stack(
+        [
+            pc / n,
+            (row_e - excess) / (n * n),
+            (col_e - excess) / (n * n),
+            (box_e - excess) / (n * n),
+            (row_u - und) / n,
+            (col_u - und) / n,
+            (box_u - und) / n,
+        ],
+        axis=-1,
+    )
+    return feats.astype(np.float32)
+
+
+def record_branch_examples(grid, geom, max_nodes: int = 50_000):
+    """Replay one solve host-side, journaling every branch decision.
+
+    Returns ``(examples, nodes)`` where each example is
+    ``{"features": [7 floats], "pc": int, "nodes": int}`` — the chosen
+    cell's feature vector and the size of the subtree its guess opened
+    (the regression target ``benchmarks/train_ordering.py`` fits).  The
+    replay is the kernel's own strategy (MRV cell, ascending digits,
+    binary guess/rest split) so examples cover exactly the states the
+    device search visits."""
+    import numpy as np
+
+    n = geom.n
+    g = np.asarray(grid, dtype=np.int64)
+    full = (1 << n) - 1
+    m0 = np.full((n, n), full, dtype=np.int64)
+    nz = g > 0
+    m0[nz] = np.int64(1) << (g[nz] - 1)
+    digits = np.arange(n, dtype=np.int64)
+
+    examples = []
+    budget = [max_nodes]
+
+    import sys
+
+    # Rest-chains recurse one frame per candidate digit eliminated; a
+    # pathological 9x9 tree can sit deeper than CPython's default 1000.
+    old_limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old_limit, 20_000))
+
+    def dfs(m):
+        """Returns (solved, subtree_nodes) — the kernel's binary scheme:
+        guess = lowest candidate digit at the MRV cell, rest = the other
+        candidates as one state (MRV re-chooses on the rest child)."""
+        m, status = _np_propagate(m, geom)
+        if status == "solved":
+            return True, 0
+        if status == "unsat" or budget[0] <= 0:
+            return False, 0
+        budget[0] -= 1
+        pc = ((m[..., None] >> digits) & 1).sum(-1)
+        key = np.where(pc > 1, pc * (n * n) + np.arange(n * n).reshape(n, n), BIG)
+        cell = int(key.argmin())
+        r, c = divmod(cell, n)
+        feats = features_np(m, geom)[r, c]
+        ex = {"features": [float(v) for v in feats], "pc": int(pc[r, c]), "nodes": 0}
+        examples.append(ex)
+        low = m[r, c] & -m[r, c]
+        guess = m.copy()
+        guess[r, c] = low
+        solved, sub_g = dfs(guess)
+        nodes = 1 + sub_g
+        if not solved:
+            rest = m.copy()
+            rest[r, c] &= ~low
+            solved, sub_r = dfs(rest)
+            nodes += sub_r
+        ex["nodes"] = nodes
+        return solved, nodes
+
+    try:
+        solved, total = dfs(m0)
+    finally:
+        sys.setrecursionlimit(old_limit)
+    return examples, total

@@ -437,7 +437,7 @@ def _fused_kernel(
             sols_d = sols_d + jnp.where(top_solved, 1, 0)
 
         undecided = live & ~top_solved & ~top_contra
-        onehot = branch_onehot_full(tops, geom, branch_rule)
+        onehot = _branch_dispatch_full(tops, geom, branch_rule)
         pick = _lowest_bit(tops) if pick_low else _highest_bit(tops)
         guess = jnp.where(onehot, pick, tops)
         rest = jnp.where(onehot, tops & ~pick, tops)
@@ -1090,3 +1090,51 @@ def solve_batch_fused(
         steals=fs.steals,
     )
     return _decode_solution(res)
+
+
+def _branch_dispatch_full(cand: jax.Array, geom: Geometry, rule: str):
+    """Trace-time branch-rule dispatch for the fused kernel (ISSUE 19).
+
+    The rule is a static Python string, so this is a pure Python ``if``:
+    legacy rules reach :func:`branch_onehot_full` unchanged (same jaxpr,
+    eqn for eqn), scored heads take :func:`_head_branch_full` below.
+
+    Defined at the BOTTOM of this module — and substituted into the
+    kernel body as a one-line call — on purpose: the jaxpr embeds source
+    LINES from this file (``_fused_kernel``'s def via pallas_call's
+    name_and_src_info, the BlockMapping index_map lambdas in
+    :func:`fused_rounds`), so any net line inserted above them would
+    drift every default-rule golden without changing a single equation.
+    """
+    if rule.startswith("head:"):
+        return _head_branch_full(cand, geom, rule)
+    return branch_onehot_full(cand, geom, rule)
+
+
+def _head_branch_full(cand: jax.Array, geom: Geometry, rule: str):
+    """Scored-head twin of ``branch_onehot_full`` on [n, n, T] (ISSUE 19).
+
+    The head's boards-last f32 score packs through the same quantized key
+    (``ordering.pack_key``): unique per cell, so the board-minimum IS the
+    argmin with the identical lowest-cell tie-break.  The kernel's
+    cell-uniform ``_unit_full`` sums are injected as the head's reduction
+    seam — ``ops/ordering.py`` never reaches into pallas internals, and
+    everything a head emits is elementwise VPU work (plus MXU matmuls for
+    the mlp head) over [n, n, T].  The lazy ``ordering`` import keeps the
+    module header line-stable (see :func:`_branch_dispatch_full`).
+    """
+    from distributed_sudoku_solver_tpu.ops import ordering
+
+    n = geom.n
+    pc = jax.lax.population_count(cand).astype(jnp.int32)
+    und = pc > 1
+    cell = (
+        jax.lax.broadcasted_iota(jnp.int32, cand.shape, 0) * n
+        + jax.lax.broadcasted_iota(jnp.int32, cand.shape, 1)
+    )
+    head = ordering.get_head(rule)
+    score = head.score_full(
+        cand, geom, unit_sum=lambda x: _unit_full(x, geom, operator.add)
+    )
+    key = ordering.pack_key(score, und, cell, n, head.quant)
+    return (key == _full_min(key)) & und

@@ -10,6 +10,7 @@ from distributed_sudoku_solver_tpu.parallel.board_sharded import (  # noqa: F401
     BandedSudoku,
     make_band_mesh,
     solve_batch_banded,
+    validate_banded_config,
 )
 from distributed_sudoku_solver_tpu.parallel.sharded import (  # noqa: F401
     solve_batch_sharded,

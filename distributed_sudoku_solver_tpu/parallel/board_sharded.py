@@ -438,20 +438,26 @@ class BandedSudoku:
 # --------------------------------------------------------------------------
 
 
-def _banded_problem(
-    geom: Geometry, config: SolverConfig, n_dev: int, axis: str
-) -> BandedSudoku:
+def validate_banded_config(config: SolverConfig) -> None:
+    """Reject solver options the banded path cannot honor — loudly.
+
+    Shared by :func:`solve_batch_banded` (the EAGER entry, so a bad
+    config fails at call time, before any trace/compile work) and
+    :func:`_banded_problem` (inside the jit, for callers that reach the
+    problem builder directly).  The CLI offers 'mixed'/'minrem-desc' and
+    the scored 'head:*' rules for the batch paths; the banded pmin-key
+    branch implements exactly the two total orders a cross-chip argmin
+    can reproduce, so anything else is a config error, never a silent
+    fallback."""
     from distributed_sudoku_solver_tpu.ops.propagate import RULE_TIERS
 
     if config.rules not in RULE_TIERS:
         raise ValueError(f"unknown rules {config.rules!r}")
     if config.branch not in ("minrem", "first"):
-        # The banded pmin-key branch implements these two orders only; fail
-        # loudly rather than silently fall back ('mixed'/'minrem-desc' are
-        # batch-path features).
         raise ValueError(
             f"board-sharded solve supports branch='minrem'|'first', "
-            f"got {config.branch!r}"
+            f"got {config.branch!r} ('mixed'/'minrem-desc' and the "
+            f"'head:*' scored rules are batch-path features)"
         )
     if config.propagator != "xla":
         # The banded sweep has its own ring-exchange collectives; the Pallas
@@ -461,6 +467,12 @@ def _banded_problem(
             f"board-sharded solve supports propagator='xla' only, "
             f"got {config.propagator!r}"
         )
+
+
+def _banded_problem(
+    geom: Geometry, config: SolverConfig, n_dev: int, axis: str
+) -> BandedSudoku:
+    validate_banded_config(config)
     bands_per_chip = -(-geom.n_vboxes // n_dev)
     return BandedSudoku(
         geom=geom,
@@ -534,5 +546,8 @@ def solve_batch_banded(
     is the thing that must span chips (giant geometries).  Results are
     bit-identical to the single-device ``solve_batch``.
     """
+    # Config-time rejection: an unsupported branch/propagator fails HERE,
+    # eagerly, instead of surfacing mid-trace inside the jit.
+    validate_banded_config(config)
     mesh = mesh if mesh is not None else make_band_mesh()
     return _solve_banded_jit(jnp.asarray(grids), geom, config, mesh)

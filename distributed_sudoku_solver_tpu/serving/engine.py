@@ -58,6 +58,7 @@ from distributed_sudoku_solver_tpu.obs import (
     compilewatch,
     critpath,
     lockdep,
+    ordertrace,
     slo,
     trace,
 )
@@ -159,6 +160,13 @@ class Job:
     # 'cache' | 'propagation' | 'native' | 'device' — or None for jobs
     # that never crossed the front door.
     route: Optional[str] = None
+    # Difficulty-probe observations (serving/frontdoor/router.py), set
+    # when the job crossed the front door's probe: the branching-slack
+    # score and empty-cell count.  -1 = never probed.  The opt-in
+    # ordering trace (obs/ordertrace.py) journals these with the route
+    # outcome so the easy/hard threshold can be learned offline.
+    probe_score: int = -1
+    probe_empties: int = -1
     # Resolution hook: called by _finish_job with the verdict fields set,
     # BEFORE the done event (the front door's cache fill — a waiter that
     # resubmits the moment it wakes must see the entry).  Exceptions are
@@ -1770,6 +1778,20 @@ class SolverEngine:
             cp = critpath.active()
             if cp is not None:
                 cp.observe_job(job.uuid, wall)
+        ot = ordertrace.active()
+        if ot is not None:
+            # Device-tier outcome + sampled grid for the offline ordering
+            # trainers (obs/ordertrace.py).  Front-door-owned routes
+            # (cache / propagation / native race) journal at their own
+            # resolution sites — this is the one place every DEVICE job
+            # passes through, front-doored or not.
+            ot.route(
+                job.uuid, job.probe_score, job.probe_empties,
+                job.route or "direct", wall * 1000.0,
+                job.solved, job.unsat, job.nodes,
+            )
+            if job.roots is None and job.grid is not None:
+                ot.grid(job.grid, job.geom.n)
         cb = job.on_resolve
         if cb is not None:
             # Front-door cache fill (serving/frontdoor): runs with the

@@ -30,7 +30,7 @@ from typing import Optional
 import numpy as np
 
 from distributed_sudoku_solver_tpu.models.geometry import Geometry
-from distributed_sudoku_solver_tpu.obs import lockdep, slo, trace
+from distributed_sudoku_solver_tpu.obs import lockdep, ordertrace, slo, trace
 from distributed_sudoku_solver_tpu.serving import brownout
 from distributed_sudoku_solver_tpu.serving.frontdoor import cache as cache_mod
 from distributed_sudoku_solver_tpu.serving.frontdoor import canonical as canon_mod
@@ -256,6 +256,10 @@ class FrontDoor:
 
         t1 = rec.now() if rec is not None else 0.0
         pr = probe_propagate(job.grid, job.geom, self.config.probe_sweeps)
+        # Journaled with the route outcome by the ordering trace
+        # (obs/ordertrace.py) — the offline threshold learner's features.
+        job.probe_score = int(pr.score)
+        job.probe_empties = int(pr.empties)
         if rec is not None:
             rec.record(
                 job.uuid, "probe", "frontdoor.probe", t1,
@@ -388,6 +392,12 @@ class FrontDoor:
                 node=eng.trace_node, route=route,
                 solved=job.solved, unsat=job.unsat,
             )
+        ot = ordertrace.active()
+        if ot is not None:
+            ot.route(
+                job.uuid, job.probe_score, job.probe_empties, route,
+                wall * 1000.0, job.solved, job.unsat, job.nodes,
+            )
         job.done.set()
 
     def _native_verdict(self, job, cf, raw) -> None:
@@ -414,6 +424,12 @@ class FrontDoor:
             self.answered_nodes += int(job.nodes)
             if route == "device":
                 self.native_fallback_wins += 1
+        ot = ordertrace.active()
+        if ot is not None:
+            ot.route(
+                job.uuid, job.probe_score, job.probe_empties, route,
+                wall * 1000.0, job.solved, job.unsat, job.nodes,
+            )
         self._fill_cache(cf, raw, job)
 
     def _device_resolved(self, job) -> None:

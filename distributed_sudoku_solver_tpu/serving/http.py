@@ -227,6 +227,33 @@ class _Handler(BaseHTTPRequestHandler):
         rec = trace.active()
         t_http = rec.now() if rec is not None else 0.0
         timeout = self.server.solve_timeout_s
+        # Optional per-request branch-ordering override (ISSUE 19): the
+        # payload may carry ``"branch": "head:cw-slack"`` etc.; it is
+        # validated HERE (400 on an unknown rule, before anything is
+        # enqueued) and rides the job as a per-job SolverConfig override —
+        # on a cluster node it travels with the TASK.
+        config = None
+        branch = payload.get("branch")
+        if branch is not None:
+            import dataclasses
+
+            from distributed_sudoku_solver_tpu.ops import ordering
+
+            try:
+                ordering.validate_branch(branch)
+            except (TypeError, ValueError) as e:
+                return self._send(400, {"error": str(e)})
+            if payload.get("portfolio"):
+                # The portfolio races its OWN per-racer configs; a single
+                # branch override is ambiguous there.  Reject loudly, the
+                # same contract as count_all+portfolio below.
+                return self._send(
+                    400, {"error": "branch and portfolio are mutually exclusive"}
+                )
+            engine = getattr(node, "engine", None)
+            if engine is None:
+                return self._send(500, {"error": "node has no engine"})
+            config = dataclasses.replace(engine.config, branch=branch)
         if payload.get("count_all"):
             if payload.get("portfolio"):
                 # Racing heterogeneous configs makes sense for find-one (first
@@ -236,7 +263,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(
                     400, {"error": "count_all and portfolio are mutually exclusive"}
                 )
-            return self._solve_count_all(node, g, start, timeout)
+            return self._solve_count_all(node, g, start, timeout, config=config)
         strategy = None
         if payload.get("portfolio"):
             try:
@@ -256,7 +283,11 @@ class _Handler(BaseHTTPRequestHandler):
             strategy = res.strategy
         else:
             try:
-                job = node.submit(grid, latency=True) if latency else node.submit(grid)
+                job = (
+                    node.submit(grid, config=config, latency=True)
+                    if latency
+                    else node.submit(grid, config=config)
+                )
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
             except BrownoutShed as e:
@@ -369,7 +400,7 @@ class _Handler(BaseHTTPRequestHandler):
                 shed=shed,
             )
 
-    def _solve_count_all(self, node, grid, start, timeout):
+    def _solve_count_all(self, node, grid, start, timeout, config=None):
         """``POST /solve`` with ``"count_all": true``: enumerate EVERY
         solution (``SolverConfig.count_all``); 200 with the exact model
         count, the first solution found (null if none), and whether the
@@ -392,7 +423,10 @@ class _Handler(BaseHTTPRequestHandler):
             # enumerates natively since round 4 (count-mode kernel,
             # ops/pallas_step.py), so no silent downgrade either way.
             job = engine.submit(
-                grid, config=dataclasses.replace(engine.config, count_all=True)
+                grid,
+                config=dataclasses.replace(
+                    config if config is not None else engine.config, count_all=True
+                ),
             )
         except ValueError as e:
             return self._send(400, {"error": str(e)})
@@ -903,7 +937,7 @@ class StandaloneNode:
         self.engine = engine
         self.address = address
 
-    def submit(self, grid, latency=None):
+    def submit(self, grid, config=None, latency=None):
         import numpy as np
 
         g = np.asarray(grid, dtype=np.int32)
@@ -913,7 +947,9 @@ class StandaloneNode:
         # resident admission queue raises EngineSaturated here and the
         # HTTP layer answers 429 + Retry-After.  Library callers using the
         # engine directly keep the quiet static-flight fallback.
-        return self.engine.submit(g, saturation="reject", latency=latency)
+        return self.engine.submit(
+            g, saturation="reject", config=config, latency=latency
+        )
 
     def cancel(self, job_uuid: str) -> None:
         self.engine.cancel(job_uuid)

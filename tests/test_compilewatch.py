@@ -231,7 +231,7 @@ def test_live_workload_exports_per_program_counts_and_cost(
         finally:
             eng.stop(timeout=2)
     sec = m["compile"]
-    assert sec["registered"] == 27  # every ENTRY_POINTS program resolved
+    assert sec["registered"] == 29  # every ENTRY_POINTS program resolved
     assert sec["recompiles_total"] == 0 and sec["armed"] is True
     # Display names are the manifest's shared vocabulary.  In a crowded
     # pytest process the serving set may be cache-warm (counts then stay

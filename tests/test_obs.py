@@ -449,7 +449,7 @@ PROM_SAMPLE = {
             },
             "unregistered": {"count": 3, "wall_ms_total": 40.25},
         },
-        "registered": 27,
+        "registered": 29,
         "compiles_total": 4,
         "recompiles_total": 0,
         "warmup_over": True,
@@ -660,7 +660,7 @@ def test_promck_over_live_prometheus_endpoint():
     # program, the cost plane's efficiency gauge is live, and the
     # critical-path histograms joined the mergeable hist keyspace.
     assert "dsst_compile_compiles_total" in raw
-    assert "dsst_compile_registered 27" in raw
+    assert "dsst_compile_registered 29" in raw
     assert 'dsst_cost_programs_flops{program="advance_status"}' in raw
     assert "dsst_cost_efficiency_achieved_gflops_per_s" in raw
     assert "dsst_critpath_jobs" in raw

@@ -1,0 +1,270 @@
+"""Scored branch-ordering heads (ISSUE 19, ROADMAP #4).
+
+Contract under test, per head:
+
+* ``head:minrem`` is **bit-exact** to the legacy ``minrem`` rule on BOTH
+  step implementations — same node counts, same solutions, same verdicts
+  (the head re-derives the historical packed key integer-for-integer).
+* ``head:cw-slack`` / ``head:mlp`` relax to **verdict-equality**: the
+  solved/unsat masks must match minrem's, solutions must be valid (clue
+  -preserving, unit-complete), and unsat verdicts are cross-checked by an
+  exhaustive ``count_all`` enumeration finding zero models.
+* the numpy feature maps the trainer reads (``features_np``) must rank
+  identically to the in-graph maps the mlp head serves — train/serve skew
+  here silently mis-ranks every branch.
+
+Plus the satellite seams: config-time branch validation (SolverConfig and
+the board-sharded reject path), the opt-in ordering trace recorder, and
+the learned easy-score threshold fit.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_sudoku_solver_tpu.models.geometry import SUDOKU_9
+from distributed_sudoku_solver_tpu.obs import ordertrace
+from distributed_sudoku_solver_tpu.ops import ordering
+from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+from distributed_sudoku_solver_tpu.ops.solve import solve_batch
+from distributed_sudoku_solver_tpu.parallel import validate_banded_config
+from distributed_sudoku_solver_tpu.serving.frontdoor.learn import fit_easy_score
+from distributed_sudoku_solver_tpu.utils.oracle import is_valid_solution
+from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9, HARD_9
+
+
+def _cfg(branch: str, **kw) -> SolverConfig:
+    kw.setdefault("min_lanes", 8)
+    kw.setdefault("stack_slots", 32)
+    kw.setdefault("max_steps", 4096)
+    return SolverConfig(branch=branch, **kw)
+
+
+def _unsat_board():
+    g = np.asarray(HARD_9[1]).copy()
+    g[1, 6] = 8  # consistent-looking wrong clue: needs deep exhaustion
+    return g
+
+
+def _mixed_grids():
+    return jnp.asarray(
+        np.stack([EASY_9, HARD_9[0], _unsat_board(), HARD_9[2]]).astype(np.int32)
+    )
+
+
+# -- rule validation -----------------------------------------------------------
+
+
+def test_validate_branch_accepts_all_shipped_rules():
+    for rule in (*ordering.LEGACY_RULES, *(f"head:{h}" for h in ordering.HEAD_NAMES)):
+        ordering.validate_branch(rule)  # must not raise
+
+
+@pytest.mark.parametrize("rule", ["head:nope", "bogus", "head:", "minrem "])
+def test_validate_branch_rejects_unknown(rule):
+    with pytest.raises(ValueError):
+        ordering.validate_branch(rule)
+
+
+def test_solver_config_validates_branch_at_construction():
+    # The satellite's point: a typo'd rule fails where the CLI/engine/HTTP
+    # boundary can still answer 4xx, not mid-trace inside a jit.
+    with pytest.raises(ValueError):
+        SolverConfig(branch="head:typo")
+
+
+def test_banded_config_rejects_batch_only_rules_loudly():
+    for rule in ("mixed", "minrem-desc", "head:cw-slack"):
+        with pytest.raises(ValueError, match="board-sharded"):
+            validate_banded_config(SolverConfig(branch=rule))
+    validate_banded_config(SolverConfig(branch="minrem"))
+    validate_banded_config(SolverConfig(branch="first"))
+
+
+# -- pack_key ------------------------------------------------------------------
+
+
+def test_pack_key_unique_per_cell_and_masks_decided():
+    n = 9
+    cells = np.arange(n * n, dtype=np.int32)
+    score = np.full(n * n, 3.0, dtype=np.float32)  # ties everywhere
+    und = np.ones(n * n, bool)
+    und[5] = False
+    key = np.asarray(
+        ordering.pack_key(jnp.asarray(score), jnp.asarray(und), jnp.asarray(cells), n, 1)
+    )
+    assert key[5] == ordering.BIG
+    live = np.delete(key, 5)
+    assert len(set(live.tolist())) == len(live)  # cell index breaks every tie
+    # argmin == lowest cell among the tied minimum scores
+    assert int(key.argmin()) == 0
+
+
+def test_pack_key_clips_runaway_scores_under_big():
+    n = 9
+    key = np.asarray(
+        ordering.pack_key(
+            jnp.asarray(np.float32(1e9)), jnp.asarray(True), jnp.asarray(7), n, 4096
+        )
+    )
+    assert 0 < int(key) < ordering.BIG
+
+
+# -- head:minrem bit-exactness -------------------------------------------------
+
+
+@pytest.mark.parametrize("step_impl", ["xla", "fused"])
+def test_head_minrem_bit_exact(step_impl):
+    grids = _mixed_grids()
+    ref = solve_batch(grids, SUDOKU_9, _cfg("minrem", step_impl=step_impl))
+    got = solve_batch(grids, SUDOKU_9, _cfg("head:minrem", step_impl=step_impl))
+    np.testing.assert_array_equal(np.asarray(got.solved), np.asarray(ref.solved))
+    np.testing.assert_array_equal(np.asarray(got.unsat), np.asarray(ref.unsat))
+    np.testing.assert_array_equal(np.asarray(got.nodes), np.asarray(ref.nodes))
+    np.testing.assert_array_equal(np.asarray(got.steps), np.asarray(ref.steps))
+    np.testing.assert_array_equal(np.asarray(got.solution), np.asarray(ref.solution))
+
+
+# -- scored heads: verdict equality --------------------------------------------
+
+
+@pytest.mark.parametrize("branch", ["head:cw-slack", "head:mlp"])
+@pytest.mark.parametrize("step_impl", ["xla", "fused"])
+def test_scored_heads_verdict_equal(branch, step_impl):
+    boards = np.stack([EASY_9, HARD_9[0], _unsat_board(), HARD_9[2]]).astype(np.int32)
+    grids = jnp.asarray(boards)
+    ref = solve_batch(grids, SUDOKU_9, _cfg("minrem", step_impl=step_impl))
+    got = solve_batch(grids, SUDOKU_9, _cfg(branch, step_impl=step_impl))
+    np.testing.assert_array_equal(np.asarray(got.solved), np.asarray(ref.solved))
+    np.testing.assert_array_equal(np.asarray(got.unsat), np.asarray(ref.unsat))
+    for i in range(len(boards)):
+        if not bool(np.asarray(got.solved)[i]):
+            continue
+        sol = np.asarray(got.solution[i])
+        assert is_valid_solution(sol, SUDOKU_9)
+        clue = boards[i] > 0
+        assert (sol[clue] == boards[i][clue]).all(), f"board {i} dropped a clue"
+
+
+def test_scored_head_unsat_cross_checked_by_count_all():
+    # The verdict-equality contract's teeth: a head claiming unsat must
+    # agree with an exhaustive enumeration finding zero models.
+    grids = jnp.asarray(_unsat_board()[None].astype(np.int32))
+    cfg = _cfg("head:cw-slack")
+    res = solve_batch(grids, SUDOKU_9, cfg)
+    assert bool(np.asarray(res.unsat)[0])
+    cnt = solve_batch(grids, SUDOKU_9, dataclasses.replace(cfg, count_all=True))
+    assert int(np.asarray(cnt.sol_count)[0]) == 0
+    assert not bool(np.asarray(cnt.overflowed)[0])  # the count is complete
+
+
+# -- train/serve feature parity ------------------------------------------------
+
+
+def test_features_np_matches_in_graph_maps():
+    g = np.asarray(HARD_9[0], dtype=np.int64)
+    n = 9
+    full = (1 << n) - 1
+    m = np.full((n, n), full, dtype=np.int64)
+    nz = g > 0
+    m[nz] = np.int64(1) << (g[nz] - 1)
+    m, status = ordering._np_propagate(m, SUDOKU_9)
+    assert status == "open"  # a hard board: propagation alone cannot close it
+
+    host = ordering.features_np(m, SUDOKU_9)  # [n, n, 7]
+
+    head = ordering.get_head("head:mlp")
+    cand = jnp.asarray(m[None].astype(np.uint32))  # [1, n, n] lanes layout
+    feats = head._features(
+        cand, SUDOKU_9, unit_sum=lambda x: ordering._unit_sums_lanes(x, SUDOKU_9)
+    )
+    graph = np.stack([np.asarray(f)[0] for f in feats], axis=-1)
+    np.testing.assert_allclose(graph, host, rtol=0, atol=1e-6)
+
+
+def test_mlp_weights_committed_and_hashable():
+    head = ordering.get_head("head:mlp")
+    assert ordering.get_head("head:mlp") is head  # lru: one instance, one jit key
+    hash(head)  # jit-static requirement
+    f = len(head.w1)
+    assert f == 7  # the feature contract _cell_features pins
+    assert all(len(row) == len(head.b1) for row in head.w1)
+    assert len(head.w2) == len(head.b1)
+
+
+def test_load_mlp_weights_rejects_unknown_schema(tmp_path):
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps({"schema": "nope/9"}))
+    with pytest.raises(ValueError, match="schema"):
+        ordering.load_mlp_weights(str(p))
+
+
+# -- the host-side branch-example recorder -------------------------------------
+
+
+def test_record_branch_examples_covers_hard_board():
+    examples, nodes = ordering.record_branch_examples(HARD_9[0], SUDOKU_9)
+    assert nodes > 0 and examples
+    for ex in examples:
+        assert len(ex["features"]) == 7
+        assert ex["pc"] >= 2  # only undecided cells branch
+        assert ex["nodes"] >= 1  # every journaled branch opened a subtree
+
+
+# -- the opt-in ordering trace -------------------------------------------------
+
+
+def test_ordertrace_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "ot.jsonl")
+    with ordertrace.installed(ordertrace.OrderTraceRecorder(path, sample_grids=2)):
+        rec = ordertrace.active()
+        assert rec is not None
+        rec.route("u1", 40, 50, "native", 1.5, True, False)
+        rec.route("u2", 80, 55, "device", 9.0, True, False, nodes=12)
+        for _ in range(4):  # sample_grids=2 -> records grids 1 and 3
+            rec.grid(np.asarray(EASY_9), 9)
+    assert ordertrace.active() is None  # scope always uninstalls
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"kind": "route", "tr')  # torn tail from a crash
+    events = ordertrace.read_events(path)
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["route", "route", "grid", "grid"]
+    assert events[1]["nodes"] == 12 and events[1]["route"] == "device"
+    assert len(events[2]["grid"]) == 81
+
+
+# -- the learned easy-score threshold ------------------------------------------
+
+
+def _route_events(rows):
+    return [
+        {"kind": "route", "score": s, "route": r, "wall_ms": w, "solved": True,
+         "unsat": False}
+        for s, r, w in rows
+    ]
+
+
+def test_fit_easy_score_moves_threshold_to_the_crossover():
+    # Native is cheap up to score 100 and catastrophic beyond; device is a
+    # flat 5 ms.  The optimal cut is therefore AT 100, not the default 64.
+    rows = []
+    for s in (20, 40, 60, 80, 100):
+        rows += [(s, "native", 1.0)] * 4 + [(s, "device", 5.0)] * 4
+    for s in (120, 140):
+        rows += [(s, "native", 50.0)] * 4 + [(s, "device", 5.0)] * 4
+    t, report = fit_easy_score(_route_events(rows), default=64, min_samples=8)
+    assert report["fitted"]
+    assert t == 100
+    assert report["cost_best"] < report["cost_default"]
+
+
+def test_fit_easy_score_keeps_default_on_thin_journal():
+    rows = [(40, "native", 1.0)] * 3 + [(90, "device", 5.0)] * 20
+    t, report = fit_easy_score(_route_events(rows), default=64, min_samples=8)
+    assert t == 64
+    assert not report["fitted"]
+    assert "needs >=" in report["reason"]

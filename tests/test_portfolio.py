@@ -253,3 +253,89 @@ def test_cover_race_device_covers_native_absence(monkeypatch):
     assert res.winner == "device"
     assert res.count == 10  # A000170(5)
     assert res.complete
+
+
+# -- the mirror partition, tested beyond construction (ISSUE 19 satellite) -----
+
+
+def test_minrem_desc_mirror_explores_the_reflected_tree_exactly():
+    """The docstring's relabel argument, pinned at bit level: d -> 10-d
+    reverses value order but preserves MRV counts and cell tie-breaks, so
+    ``minrem-desc`` on the mirror walks the EXACT tree ``minrem`` walks on
+    the original — same nodes, same steps, mirrored solution.  This is the
+    invariant that makes the asc/desc pair a work PARTITION: whatever one
+    racer explores first, the other explores last, never twice."""
+    b = np.asarray(HARD_9[0], np.int32)
+    mb = _mirror(b)
+
+    def run(board, rule):
+        r = solve_batch(jnp.asarray(board[None]), SUDOKU_9, _cfg(rule))
+        assert bool(r.solved[0])
+        return int(r.nodes[0]), int(r.steps), np.asarray(r.solution[0])
+
+    n_asc, s_asc, sol_asc = run(b, "minrem")
+    n_dm, s_dm, sol_dm = run(mb, "minrem-desc")
+    assert (n_asc, s_asc) == (n_dm, s_dm)
+    np.testing.assert_array_equal(_mirror(sol_asc), sol_dm)
+
+    n_desc, s_desc, _ = run(b, "minrem-desc")
+    n_am, s_am, _ = run(mb, "minrem")
+    assert (n_desc, s_desc) == (n_am, s_am)
+    # And the pair is genuinely complementary on this board: one order is
+    # much luckier than the other (the portfolio's whole reason to exist).
+    assert n_asc != n_desc
+
+
+def test_value_orders_partition_subtrees_no_duplicates():
+    """'No duplicated subtree verdicts': exhaustive enumeration visits
+    every model exactly once under EITHER value order, so asc and desc
+    must report the identical exact count — a duplicated (or dropped)
+    subtree would show up as a count mismatch."""
+    from distributed_sudoku_solver_tpu.utils.puzzles import EASY_9
+
+    few = np.asarray(EASY_9, np.int32).copy()
+    rng = np.random.default_rng(3)
+    idx = np.flatnonzero(few.ravel())
+    few.ravel()[rng.choice(idx, size=4, replace=False)] = 0  # 62 solutions
+    grids = jnp.asarray(few[None])
+    cfg = lambda rule: SolverConfig(  # noqa: E731
+        min_lanes=8, stack_slots=32, branch=rule, max_steps=100_000,
+        count_all=True,
+    )
+    asc = solve_batch(grids, SUDOKU_9, cfg("minrem"))
+    desc = solve_batch(grids, SUDOKU_9, cfg("minrem-desc"))
+    assert not bool(asc.overflowed[0]) and not bool(desc.overflowed[0])
+    # 62 is the exhaustive count (pinned against the native DFS by
+    # tests/test_fused_step.py) — matching it proves BOTH orders walked
+    # the complete tree, not truncated-by-budget partials.
+    assert int(asc.sol_count[0]) == int(desc.sol_count[0]) == 62
+
+
+def test_branch_site_guess_sets_are_disjoint():
+    """At a shared branch state the two orders pick the SAME cell (the key
+    ignores direction) but disjoint first guesses (lowest vs highest
+    candidate bit) — the root split each racer hands the other."""
+    from distributed_sudoku_solver_tpu.ops import ordering as _ord
+    from distributed_sudoku_solver_tpu.ops.bitmask import lowest_bit
+    from distributed_sudoku_solver_tpu.ops.pallas_step import branch_onehot_full
+
+    n = 9
+    g = np.asarray(HARD_9[0], np.int64)
+    m = np.full((n, n), (1 << n) - 1, dtype=np.int64)
+    nz = g > 0
+    m[nz] = np.int64(1) << (g[nz] - 1)
+    m, status = _ord._np_propagate(m, SUDOKU_9)
+    assert status == "open"
+
+    cand = jnp.asarray(m[..., None].astype(np.uint32))  # boards-last [n, n, 1]
+    one_asc = np.asarray(branch_onehot_full(cand, SUDOKU_9, "minrem"))
+    one_desc = np.asarray(branch_onehot_full(cand, SUDOKU_9, "minrem-desc"))
+    np.testing.assert_array_equal(one_asc, one_desc)  # same cell either way
+    assert one_asc.sum() == 1
+
+    r, c, _ = np.argwhere(one_asc)[0]
+    cell = int(m[r, c])
+    low = int(np.asarray(lowest_bit(jnp.asarray(np.uint32(cell)))))
+    high = int(np.asarray(highest_bit(jnp.asarray(np.uint32(cell)))))
+    assert low & high == 0  # disjoint first subtrees
+    assert (low | high) & ~cell == 0  # both are real candidates
