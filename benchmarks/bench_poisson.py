@@ -74,6 +74,15 @@ per-route and per-tier percentiles beside the overall numbers.  Mixed
 artifacts carry the mix in ``params``; ``benchmarks/regress.py`` refuses
 to compare artifacts with different mixes (exit 2 — different workload,
 not a regression).
+
+``--kill-at S --restart-after S`` (round 21, ISSUE 20) adds the
+durability pass: a journal-backed engine (``serving/journal.py``) takes
+the stream, is killed abruptly S seconds in (WAL batcher dies
+mid-buffer — a crash, not a drain), restarts over the same WAL
+directory, and replays.  Jobs recovered + recovery wall land in a
+``recovery`` artifact section; the kill params mark the whole artifact
+non-comparable in ``regress.py`` (exit 2 — a truncated stream is not a
+workload measurement).
 """
 
 from __future__ import annotations
@@ -786,6 +795,105 @@ def mesh_pass(
         eng.stop(timeout=2)
 
 
+def recovery_pass(
+    n_jobs: int,
+    mean_gap_s: float,
+    handicap_s: float,
+    chunk_steps: int,
+    seed: int,
+    kill_at_s: float,
+    restart_after_s: float,
+) -> dict:
+    """Kill/restart durability measurement (ISSUE 20): a journal-backed
+    engine takes the Poisson stream, is killed ABRUPTLY ``kill_at_s``
+    seconds in — the WAL's fsync batcher dies mid-buffer and in-flight
+    finalizations never reach the disk, the crash a clean shutdown would
+    hide — then after ``restart_after_s`` a fresh engine boots over the
+    same WAL directory, replays every unresolved entry through the
+    normal submit seam, and the replay wall is measured.  The numbers
+    the durability table wants: how many accepted jobs the crash caught,
+    and how long the restart took to pay them all off.
+    """
+    import shutil
+    import tempfile
+
+    from distributed_sudoku_solver_tpu.ops.frontier import SolverConfig
+    from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+    from distributed_sudoku_solver_tpu.serving.journal import Journal
+
+    cfg = SolverConfig(min_lanes=8, stack_slots=16)
+    boards = _corpus(n_jobs)
+    gaps = poisson_gaps(len(boards), mean_gap_s, seed)
+    wal_dir = tempfile.mkdtemp(prefix="dsst-wal-")
+    try:
+        jr = Journal(wal_dir)
+        eng = SolverEngine(
+            config=cfg,
+            max_batch=8,
+            handicap_s=handicap_s,
+            chunk_steps=chunk_steps,
+            journal=jr,
+        ).start()
+        warm = eng.submit(boards[0])  # compile warm; resolves pre-kill
+        assert warm.wait(300)
+        submitted = 0
+        deadline = time.monotonic() + kill_at_s
+        for i, board in enumerate(boards):
+            if time.monotonic() >= deadline:
+                break
+            eng.submit(np.asarray(board, np.int32), job_uuid=f"rec-{i}")
+            submitted += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            if i + 1 < len(boards):
+                time.sleep(min(gaps[i], remaining))
+        # The crash: detach the journal so post-mortem finalizations never
+        # reach the WAL, stop the batcher WITHOUT the final drain (its
+        # buffered resolves are lost), then tear the engine down.  What
+        # survives on disk is what a kill -9 would have left.
+        eng.journal = None
+        jr._stop.set()
+        jr._batcher.join(timeout=5)
+        eng.stop(timeout=2)
+        time.sleep(restart_after_s)
+
+        jr2 = Journal(wal_dir)
+        uuids = [ev["uuid"] for ev in jr2.unresolved()]
+        eng2 = SolverEngine(
+            config=cfg,
+            max_batch=8,
+            handicap_s=handicap_s,
+            chunk_steps=chunk_steps,
+            journal=jr2,
+        ).start()
+        try:
+            t0 = time.monotonic()
+            n = eng2.recover()
+            handles = [eng2._dup_job(u) for u in uuids]
+            ok = all(
+                h is not None and h.wait(600) and (h.solved or h.unsat)
+                for h in handles
+            )
+            recovery_wall = time.monotonic() - t0
+            jr2.sync_now()
+            leftover = len(jr2.unresolved())
+        finally:
+            eng2.stop(timeout=2)
+            jr2.shutdown()
+        return {
+            "kill_at_ms": round(kill_at_s * 1e3, 3),
+            "restart_after_ms": round(restart_after_s * 1e3, 3),
+            "jobs_submitted": submitted,
+            "jobs_recovered": int(n),
+            "recovery_wall_ms": round(recovery_wall * 1e3, 3),
+            "replayed_ok": bool(ok),
+            "wal_leftover": int(leftover),
+        }
+    finally:
+        shutil.rmtree(wal_dir, ignore_errors=True)
+
+
 def main() -> None:
     import argparse
     import json
@@ -878,6 +986,29 @@ def main() -> None:
         "the report/artifact which benchmarks/regress.py gates whenever "
         "both artifacts carry it",
     )
+    ap.add_argument(
+        "--kill-at",
+        type=float,
+        default=None,
+        metavar="S",
+        help="durability pass (ISSUE 20): run the Poisson stream against "
+        "a journal-backed engine, kill it ABRUPTLY S seconds in (the "
+        "WAL fsync batcher dies mid-buffer — a crash, not a drain), "
+        "restart over the same WAL directory after --restart-after "
+        "seconds, and measure the replay: jobs recovered + recovery "
+        "wall land in a 'recovery' artifact section, and the kill "
+        "params land in the artifact params — so benchmarks/regress.py "
+        "refuses to gate a kill-run artifact (exit 2: the stream was "
+        "truncated mid-run, its quantiles are not a workload measure)",
+    )
+    ap.add_argument(
+        "--restart-after",
+        type=float,
+        default=1.0,
+        metavar="S",
+        help="seconds between the kill and the restart of the "
+        "--kill-at durability pass (default 1.0)",
+    )
     ap.add_argument("--json", action="store_true")
     ap.add_argument(
         "--trace-out",
@@ -908,6 +1039,10 @@ def main() -> None:
         ap.error("--ring needs at least 3 members to measure sharing")
     if args.mesh_devices < 0:
         ap.error("--mesh-devices must be >= 0")
+    if args.kill_at is not None and args.kill_at <= 0:
+        ap.error("--kill-at must be > 0 seconds into the stream")
+    if args.restart_after < 0:
+        ap.error("--restart-after must be >= 0")
     if args.mesh_devices:
         # Must land before ANY jax import (everything jax-touching in this
         # file is deliberately lazy): the forced host-platform device
@@ -959,6 +1094,16 @@ def main() -> None:
                 chunk_steps=args.chunk_steps,
                 seed=args.seed,
                 mesh_devices=args.mesh_devices,
+            )
+        if args.kill_at is not None:
+            out["recovery"] = recovery_pass(
+                n_jobs=args.jobs,
+                mean_gap_s=args.mean_ms / 1e3,
+                handicap_s=args.handicap_ms / 1e3,
+                chunk_steps=args.chunk_steps,
+                seed=args.seed,
+                kill_at_s=args.kill_at,
+                restart_after_s=args.restart_after,
             )
         if args.ring:
             out["ring"] = ring_pass(
@@ -1047,6 +1192,17 @@ def main() -> None:
                     if args.branch != "minrem"
                     else {}
                 ),
+                # Only present on kill/restart durability runs (ISSUE
+                # 20): the keys mark the artifact's stream as truncated
+                # mid-run, which regress.py refuses to gate (exit 2).
+                **(
+                    {
+                        "kill_at_s": args.kill_at,
+                        "restart_after_s": args.restart_after,
+                    }
+                    if args.kill_at is not None
+                    else {}
+                ),
             },
             "static": out["static"],
             "resident": out["resident"],
@@ -1088,6 +1244,17 @@ def main() -> None:
             # a 4-device artifact is a different machine shape, not a
             # regression: exit 2).
             **({"mesh": out["mesh"]} if args.mesh_devices else {}),
+            # The durability pass (ISSUE 20): recovery time + jobs
+            # recovered after an abrupt kill.  Additive like the tiers
+            # above, but the params kill keys make the whole artifact
+            # non-comparable in regress.py — the measured stream was
+            # truncated at the kill, so its static/resident quantiles
+            # describe an interrupted workload, not the benchmark's.
+            **(
+                {"recovery": out["recovery"]}
+                if args.kill_at is not None
+                else {}
+            ),
         }
         tmp = args.out_json + ".tmp"
         with open(tmp, "w") as f:

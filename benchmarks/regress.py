@@ -129,6 +129,28 @@ def compare(old: dict, new: dict, tol: float = 0.25) -> dict:
                 f"{doc.get('schema') if isinstance(doc, dict) else doc!r}, "
                 f"expected {SCHEMA}"
             )
+    if not errors:
+        # Kill/restart durability runs (bench_poisson --kill-at, ISSUE
+        # 20): the stream was truncated at the kill, so the artifact's
+        # quantiles measured an interrupted workload — never gate one,
+        # in either position, even against another kill-run with
+        # identical params.  Refuse explicitly (exit 2), like the
+        # cross-mix and mesh-shape rules: different measurement, not a
+        # regression.
+        killed = [
+            label
+            for label, doc in (("old", old), ("new", new))
+            if "kill_at_s" in (doc.get("params") or {})
+        ]
+        if killed:
+            errors.append(
+                f"{' and '.join(killed)} artifact(s) came from a "
+                "kill/restart durability run (bench_poisson --kill-at): "
+                "the stream was truncated mid-run, so the quantiles are "
+                "not comparable workload measurements — use the "
+                "'recovery' section for the durability table and re-run "
+                "without --kill-at for the bench-trajectory gate"
+            )
     if not errors and old.get("params") != new.get("params"):
         om = (old.get("params") or {}).get("mix")
         nm = (new.get("params") or {}).get("mix")

@@ -135,6 +135,18 @@ LAYERS = {
     # (engine_signals' duck-typed closures) — importing the engine back
     # would cycle through engine.metrics' brownout section.
     "serving.brownout": {"closed": True, "allow": ("obs",), "third_party": ()},
+    # The job journal (serving/journal.py, ISSUE 20) is a closed
+    # stdlib+obs layer like brownout, plus the serving.faults sites
+    # (journal.append / journal.fsync) — faults is itself stdlib +
+    # obs.lockdep, so the "no heavy deps" promise holds transitively and
+    # the lint.yml fast lane proves the no-jax import at runtime.  The
+    # engine reaches it through the install/active seam; the journal
+    # never imports the engine back.
+    "serving.journal": {
+        "closed": True,
+        "allow": ("obs", "serving.faults"),
+        "third_party": (),
+    },
     # serving sits BELOW cluster (cluster/node.py imports serving.engine):
     # a serving -> cluster import would be a cycle by construction.
     "serving": {"closed": False, "forbid": ("cluster",)},
@@ -714,6 +726,13 @@ LOCK_RANKS = {
     # nothing is ever acquired under it.
     "serving.brownout": 28,   # serving/brownout.py BrownoutController._lock
     "serving.engine": 30,     # serving/engine.py SolverEngine._lock
+    # The WAL lock sits just above the engine lock and BELOW the fault
+    # injector: record_resolved runs under engine._lock on the
+    # stop/drain sweep (30 -> 32 legal), and every append fires the
+    # journal.append/journal.fsync fault sites while holding it
+    # (32 -> 40 legal).  Nothing else is ever acquired under it — the
+    # batcher thread takes it alone.
+    "serving.journal": 32,    # serving/journal.py Journal._lock
     "serving.scheduler": 34,  # serving/scheduler.py ResidentFlight._lock
     # The mesh flight's telemetry lock sits between its parent's lock and
     # the megastep: MeshResidentFlight.metrics acquires the inherited
@@ -826,6 +845,10 @@ LOCK_EDGE_DECLARED.update({
         # one is installed (round 18) — same injected-callable closure.
         "serving.brownout",
         "serving.engine",
+        # engine.metrics reads the installed journal's counters (round
+        # 23) — same injected-callable closure, same rank-upward
+        # legality (obs.slo 24 < serving.journal 32).
+        "serving.journal",
         "serving.scheduler",
         # engine.metrics reads the mesh flight's telemetry section
         # (round 21) — same injected-callable closure, same rank-upward
@@ -892,6 +915,8 @@ DEADCK_BASE_CLASSES = {
     # name-based over-approximation manufactures a phantom
     # cluster.simnet -> cluster.dhtcache hold under the net condition.
     "self._schedule": ("serving/faults.py", "FaultSchedule"),
+    "jr": ("serving/journal.py", "Journal"),
+    "self.journal": ("serving/journal.py", "Journal"),
 }
 
 # The repo's thread roots: qualname prefixes (per file) whose bodies run
@@ -941,6 +966,12 @@ DEADCK_THREAD_ROOTS = {
         # the flight is reachable from concurrent submit threads, so
         # guard inference must prove them all.
         "MegastepFlight.solve",
+    ),
+    "serving/journal.py": (
+        # The fsync batcher daemon: one fsync per interval covers every
+        # append since the last — the durability write that must never
+        # run on the device loop thread runs here instead.
+        "Journal._fsync_loop",
     ),
     "serving/brownout.py": (
         # The controller is reached from HTTP handler threads (the front

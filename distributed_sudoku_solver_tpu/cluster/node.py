@@ -545,6 +545,13 @@ class ClusterNode:
         self._parts: dict[str, str] = {}  # part_uuid -> root uuid (parts run here)
         self._outstanding: dict[str, int] = {}  # member -> in-flight count
         self._rr = 0
+        # Idempotent client resubmit (ISSUE 20): client-supplied uuid ->
+        # live handle, so a retry of an in-flight/resolved job returns the
+        # existing verdict instead of double-solving (the engine keeps the
+        # same registry for its own jobs; this one covers REMOTE dispatch
+        # too).  Bounded; error terminals are evicted at lookup so a retry
+        # after an infra failure runs fresh.
+        self._client_jobs: dict[str, Job] = {}  # lockck: guard(_lock)
         # Shed-part counters: bumped by concurrent NEEDWORK/SUBTASK
         # handler threads (deadck guard inference caught subtasks_run
         # outside the lock — a lost-update race since round 10).
@@ -676,6 +683,83 @@ class ClusterNode:
     def kill(self) -> None:
         """Abrupt death for fault-injection tests: no LEAVE, just silence."""
         self.stop(graceful=False)
+
+    # -- durable lifecycle (ISSUE 20) ----------------------------------------
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Graceful drain, cluster-aware: mark this member browning in the
+        gossip plane (peers stop affinity-routing here immediately, ahead
+        of the LEAVE), then walk the engine's lifecycle ladder with
+        :meth:`_handoff_job` offered for every unstarted job — shipped to
+        a gossip-healthy ring peer over the EXISTING TASK frame, so the
+        receiving member needs no new wire surface.  Returns the engine's
+        drain summary dict.  The caller still owns ``stop()``: drain
+        quiesces the engine, it does not leave the ring."""
+        if self.gossip is not None:
+            self.gossip.set_brown(True)
+        return self.engine.drain(timeout=timeout, handoff=self._handoff_job)
+
+    def _handoff_job(self, job) -> bool:
+        """Ship one detached (accepted, unstarted) job to a healthy peer
+        during drain.  Placement mirrors submit(): the digest's ring owner
+        when it is gossip-healthy and not us, else the least-outstanding
+        healthy peer.  False (journal for restart instead) when no healthy
+        peer exists or the send fails — handoff is an optimization over
+        the WAL, never a second source of truth."""
+        if job.grid is None:
+            return False
+        with self._lock:
+            peers = [m for m in self.network if m != self.addr_s]
+        peers = [
+            m
+            for m in peers
+            if self.gossip is None or self.gossip.is_healthy(m)
+        ]
+        if not peers:
+            return False
+        target = None
+        if (
+            self.dcache is not None
+            and self.config.dht_affinity
+            and getattr(self.engine, "frontdoor", None) is not None
+        ):
+            owner = self._affinity_owner(job.grid)
+            if owner is not None and owner != self.addr_s:
+                target = owner
+        if target is None:
+            with self._lock:
+                target = min(
+                    (self._outstanding.get(m, 0), m) for m in peers
+                )[1]
+        cfg_dict = (
+            dataclasses.asdict(job.config) if job.config is not None else None
+        )
+        payload = {
+            "method": "TASK",
+            "uuid": job.uuid,
+            "grid": np.asarray(job.grid).tolist(),
+            "origin": self.addr_s,
+            "config": cfg_dict,
+        }
+        if trace.active() is not None:
+            payload["trace"] = job.uuid
+        try:
+            self._send(target, payload)
+        except WireError:
+            return False
+        self._track(target, +1)
+        rec = trace.active()
+        if rec is not None:
+            rec.event(
+                str(job.uuid), "drain.handoff", "engine.lifecycle",
+                node=self.addr_s, member=target,
+            )
+        return True
+
+    def recover(self) -> int:
+        """Replay the WAL through the engine's normal submit seam
+        (``SolverEngine.recover``) — called by the CLI after a restart
+        rejoins the ring, so replayed jobs route exactly like fresh ones."""
+        return self.engine.recover()
 
     # -- ring derivation -----------------------------------------------------
     def _ring(self) -> tuple[Optional[str], Optional[str]]:
@@ -1548,17 +1632,36 @@ class ClusterNode:
             self.engine.cancel(p)
 
     # -- job dispatch --------------------------------------------------------
-    def submit(self, grid, config=None, latency=None) -> Job:
+    def submit(self, grid, config=None, latency=None, job_uuid=None) -> Job:
         """Dispatch one job to the least-loaded member; ``config`` optionally
         overrides the solver strategy for this job (rides the TASK).
 
         ``latency`` opts a LOCAL dispatch into the engine's megastep tier
         (serving/megastep.py).  The flag deliberately does not ride the
         wire: latency-mode is a node-local serving decision — a member
-        serves remote TASKs by its own engine's ``latency_mode`` default."""
+        serves remote TASKs by its own engine's ``latency_mode`` default.
+
+        ``job_uuid`` is the OPTIONAL client-supplied idempotency key
+        (ISSUE 20): a resubmit of an in-flight or resolved job returns the
+        existing handle — same verdict, no double solve, no double
+        stats — and the uuid keys the WAL entry, so a client retrying a
+        504 after a crash-restart dedupes against the replayed job."""
         g = np.asarray(grid, dtype=np.int32)
         if g.ndim != 2 or g.shape[0] != g.shape[1]:
             raise ValueError(f"grid must be square, got {g.shape}")
+        if job_uuid is not None:
+            with self._lock:
+                prev = self._client_jobs.get(job_uuid)
+                if (
+                    prev is not None
+                    and prev.done.is_set()
+                    and prev.error is not None
+                ):
+                    # Infra-error terminal: evict so the retry runs fresh.
+                    self._client_jobs.pop(job_uuid, None)
+                    prev = None
+            if prev is not None:
+                return prev
         member = None
         if (
             self.dcache is not None
@@ -1579,10 +1682,20 @@ class ClusterNode:
             # of quietly growing an unbounded queue.  Remote dispatch has
             # no cross-wire backpressure: the TASK lands in the member's
             # static path if its resident flight is full.
-            return self._submit_local(
-                g, config=config, saturation="reject", latency=latency
+            handle = self._submit_local(
+                g, config=config, saturation="reject", latency=latency,
+                job_uuid=job_uuid,
             )
-        return self._submit_remote(g, member, config=config)
+        else:
+            handle = self._submit_remote(
+                g, member, config=config, job_uuid=job_uuid
+            )
+        if job_uuid is not None:
+            with self._lock:
+                self._client_jobs[job_uuid] = handle
+                while len(self._client_jobs) > 8192:
+                    self._client_jobs.pop(next(iter(self._client_jobs)))
+        return handle
 
     def race(self, grid, configs, timeout: Optional[float] = None):
         """Cluster-level portfolio: one racer per config, spread over the
@@ -1646,10 +1759,13 @@ class ClusterNode:
 
     def _submit_local(
         self, g: np.ndarray, config=None, saturation: str = "fallback",
-        latency=None,
+        latency=None, job_uuid=None,
     ) -> Job:
         geom = geometry_for_size(g.shape[0])
-        ju = str(uuid_mod.uuid4())
+        # A client-supplied uuid IS the job uuid end to end: it keys the
+        # engine's resubmit registry and the WAL entry, so dedupe and
+        # crash replay line up with what the client will retry with.
+        ju = job_uuid if job_uuid is not None else str(uuid_mod.uuid4())
         handle = Job(uuid=ju, grid=g, geom=geom)
         self._track(self.addr_s, +1)
 
@@ -1669,10 +1785,19 @@ class ClusterNode:
             raise
         return handle
 
-    def _submit_remote(self, g: np.ndarray, member: str, config=None) -> Job:
+    def _submit_remote(
+        self, g: np.ndarray, member: str, config=None, job_uuid=None
+    ) -> Job:
         geom = geometry_for_size(g.shape[0])
-        # clockck: allow(uuid entropy, not a timing decision — ns-unique per node; virtualizing it would COLLIDE ids under simnet's frozen clock)
-        job = Job(uuid=f"{self.addr_s}/{time.monotonic_ns()}", grid=g, geom=geom)
+        if job_uuid is not None:
+            # A client-supplied uuid IS the job uuid end to end (dedupe
+            # registry, WAL entry, TASK frame) — same contract as
+            # _submit_local.
+            ju = job_uuid
+        else:
+            # clockck: allow(uuid entropy, not a timing decision — ns-unique per node; virtualizing it would COLLIDE ids under simnet's frozen clock)
+            ju = f"{self.addr_s}/{time.monotonic_ns()}"
+        job = Job(uuid=ju, grid=g, geom=geom)
         cfg_dict = dataclasses.asdict(config) if config is not None else None
         with self._lock:
             self._ledger[job.uuid] = {
@@ -1682,6 +1807,14 @@ class ClusterNode:
                 "config": cfg_dict,
             }
         self._track(member, +1)
+        jr = self.engine._journal()
+        if jr is not None:
+            # Remote dispatch never touches the local engine's submit
+            # seam, so the WAL promise is kept HERE: the ORIGIN owns the
+            # client's job, and an origin crash mid-dispatch must replay
+            # it (the member's own journal, if any, only covers the
+            # member's copy).  Discharged in _on_solution.
+            jr.record_accepted(job.uuid, grid=g, config=cfg_dict)
         payload = {
             "method": "TASK",
             "uuid": job.uuid,
@@ -2112,6 +2245,21 @@ class ClusterNode:
         handle.error = msg.get("error")
         if msg["solution"] is not None:
             handle.solution = np.asarray(msg["solution"], dtype=np.int32)
+        if handle.error is None:
+            # Real remote verdict: discharge the origin's WAL entry
+            # (permanent remote errors stay accepted-only on purpose — a
+            # restart replays them, the journal's at-least-once contract).
+            jr = self.engine._journal()
+            if jr is not None:
+                jr.record_resolved(
+                    handle.uuid,
+                    {
+                        "solved": bool(handle.solved),
+                        "unsat": bool(handle.unsat),
+                        "cancelled": bool(handle.cancelled),
+                        "nodes": int(handle.nodes),
+                    },
+                )
         handle.done.set()
 
     # -- views (HTTP layer) --------------------------------------------------

@@ -67,6 +67,7 @@ from distributed_sudoku_solver_tpu.obs.logctx import job_log, uuids_label
 from distributed_sudoku_solver_tpu.ops.frontier import Frontier, SolverConfig
 from distributed_sudoku_solver_tpu.ops.solve import solve_batch
 from distributed_sudoku_solver_tpu.serving import brownout, faults
+from distributed_sudoku_solver_tpu.serving import journal as journal_wal
 
 # Diagnostics go through logging (stderr via the root handler / logging's
 # lastResort), not print(): failure paths log at ERROR with the fault
@@ -238,6 +239,19 @@ class _Control:
     error: Optional[str] = None  # servicer-side exception, for exec callers
 
 
+class EngineDraining(RuntimeError):
+    """Raised by ``submit`` once the drain ladder has left the ``serving``
+    state: admission is closed for NEW work (duplicate resubmits of
+    already-accepted uuids still answer from the idempotency registry).
+    The HTTP layer turns this into 503 + Retry-After with a machine body
+    — the rolling-restart client contract."""
+
+    def __init__(self, state: str, retry_after_s: float = 5.0):
+        super().__init__(f"engine {state}: admission closed")
+        self.state = state
+        self.retry_after_s = retry_after_s
+
+
 class SolverEngine:
     """Single-owner device loop consuming a thread-safe job queue."""
 
@@ -256,6 +270,7 @@ class SolverEngine:
         frontdoor=None,  # Optional[serving.frontdoor.FrontDoorConfig]
         latency_mode: bool = False,
         megastep=None,  # Optional[serving.megastep.MegastepConfig]
+        journal=None,  # Optional[serving.journal.Journal]
     ):
         self.config = config
         self.max_batch = max_batch
@@ -426,6 +441,30 @@ class SolverEngine:
         self._occ_hist = np.zeros(10, np.int64)
         self._occ_frac_sum = 0.0
         self._occ_chunks = 0
+        # Durable job lifecycle (serving/journal.py, ISSUE 20).  The WAL
+        # records `accepted` before the client's 201 and discharges it on
+        # REAL verdicts only; `recover()` replays the difference on boot.
+        # An explicit ctor journal wins; otherwise the process-wide seam
+        # (journal_wal.active()) is consulted per record — one global
+        # read + one branch when nothing is installed, like faults/slo.
+        self.journal = journal
+        # The drain ladder: 'serving' -> 'draining' -> 'drained'.  submit
+        # rejects new work (EngineDraining -> HTTP 503 + Retry-After) the
+        # moment the state leaves 'serving'; duplicate resubmits of known
+        # uuids still answer.
+        self._lifecycle = "serving"  # lockck: guard(_lock)
+        self.drain_handoffs = 0  # lockck: guard(_lock) — unstarted jobs shipped to a peer
+        self.drain_journaled = 0  # lockck: guard(_lock) — unstarted jobs left to WAL replay
+        self.drain_finished = 0  # lockck: guard(_lock) — in-flight jobs finished during drain
+        self.recovered_jobs = 0  # lockck: guard(_lock) — journal entries replayed on boot
+        self._drain_wait = threading.Event()  # never set: drain's pacing timer
+        # Idempotent-resubmit registry (insertion-ordered, bounded): every
+        # non-shadow submit parks its Job here so a client retry with the
+        # same uuid — the retry-after-crash story — returns the SAME job
+        # (in-flight) or its real verdict (resolved) instead of
+        # double-solving and double-counting stats/SLO.  Error terminals
+        # are evicted at lookup so a genuine retry runs fresh.
+        self._jobs_by_uuid: "dict[str, Job]" = {}  # lockck: guard(_lock)
         # Node identity for trace spans (obs/trace.py): a cluster node sets
         # this to its wire address so a stitched multi-node trace
         # attributes each engine span to the host that recorded it.
@@ -457,6 +496,211 @@ class SolverEngine:
         # _stop (set before we took the lock) and raised in submit().
         with self._lock:
             self._drain_on_stop()
+
+    # -- durable lifecycle (serving/journal.py, ISSUE 20) ---------------------
+    def _journal(self):
+        """The engine's journal: the ctor-injected one, else the
+        process-wide seam — one global read + one branch when nothing is
+        installed (the faults/slo pattern)."""
+        return self.journal if self.journal is not None else journal_wal.active()
+
+    def lifecycle(self) -> str:
+        with self._lock:
+            return self._lifecycle
+
+    def _dup_job(self, job_uuid: str) -> Optional[Job]:
+        """Idempotency lookup: the live or real-verdict Job for a
+        resubmitted uuid, or None.  An ERROR terminal is evicted here —
+        the client's retry gets a fresh solve, not the stale failure."""
+        with self._lock:
+            prev = self._jobs_by_uuid.get(job_uuid)
+            if prev is None:
+                return None
+            if prev.done.is_set() and prev.error is not None:
+                self._jobs_by_uuid.pop(job_uuid, None)
+                return None
+            return prev
+
+    def _journal_resolved(self, job: Job) -> None:
+        """Terminal-site hook (every resolution path): discharge the
+        job's WAL entry on a REAL verdict (solved/unsat/exhausted/
+        cancelled).  Infra-error terminals keep the entry accepted-only
+        — exactly the set ``recover()`` replays on the next boot — and
+        drop out of the idempotency registry so a retry runs fresh.
+        Safe on the device loop: ``record_resolved`` only buffers (the
+        journal's batcher thread does the disk write)."""
+        if job.shadow:
+            return
+        real = job.error is None
+        if not real:
+            with self._lock:
+                self._jobs_by_uuid.pop(job.uuid, None)
+            return
+        jr = self._journal()
+        if jr is not None:
+            jr.record_resolved(
+                job.uuid,
+                {
+                    "solved": bool(job.solved),
+                    "unsat": bool(job.unsat),
+                    "cancelled": bool(job.cancelled),
+                    "exhausted": bool(job.exhausted),
+                    "nodes": int(job.nodes),
+                },
+            )
+
+    def recover(self) -> int:
+        """Boot-time journal replay: re-submit every ``accepted`` entry
+        with no ``resolved`` through the NORMAL submit seam (front door,
+        resident routing, megastep — a replayed job is just a job), and
+        warm the front-door L1 from the drain-time snapshot.  At-least-
+        once is safe: verdicts are deterministic and cache fills /
+        cluster dedupe are idempotent by uuid.  Returns the number of
+        jobs replayed."""
+        jr = self._journal()
+        if jr is None:
+            return 0
+        if self.frontdoor is not None:
+            warmed = self.frontdoor.cache.import_hot(jr.load_frontdoor())
+            if warmed:
+                _LOG.info(
+                    "[engine] front-door cache restored warm: %d entries",
+                    warmed,
+                )
+        entries = jr.unresolved()
+        n = 0
+        for ev in entries:
+            grid = ev.get("grid")
+            if grid is None:
+                continue  # nothing replayable without a board
+            cfg = None
+            try:
+                if ev.get("config"):
+                    cfg = SolverConfig(**ev["config"])
+                self.submit(
+                    grid,
+                    job_uuid=ev.get("uuid"),
+                    config=cfg,
+                    deadline_s=ev.get("deadline_s"),
+                )
+                n += 1
+            except Exception as e:  # noqa: BLE001 — one bad entry must not sink the rest
+                _LOG.error(
+                    "[engine] journal replay failed for %s: %r",
+                    ev.get("uuid"), e,
+                )
+        if n:
+            with self._lock:
+                self.recovered_jobs += n
+            jr.mark_recovered(n)
+            rec = trace.active()
+            if rec is not None:
+                rec.event(
+                    None, "journal.recover", "engine.lifecycle",
+                    node=self.trace_node, jobs=n,
+                )
+                # The flight-recorder moment: a reborn node just replayed
+                # its WAL — dump the ring + a metrics snapshot so the
+                # post-crash forensics start from the recovery point.
+                rec.dump("journal_recovery", metrics=self.metrics())
+        return n
+
+    def drain(self, timeout: float = 30.0, handoff=None) -> dict:
+        """Graceful drain, the ladder's middle rung: serving -> draining
+        -> drained.  New admission starts failing with
+        :class:`EngineDraining` (HTTP: 503 + Retry-After) the moment the
+        state flips; then
+
+        1. unstarted work (static queue + resident admission queues) is
+           DETACHED: each job is offered to ``handoff`` (the cluster
+           layer ships it to a gossip-healthy ring peer via the existing
+           TASK frames) — shipped jobs discharge their WAL entry, the
+           rest stay ``accepted``-only so the restart replays them;
+        2. in-flight flights FINISH (bounded by ``timeout``) — the
+           device loop keeps running until :meth:`stop`;
+        3. the front-door L1 hot set persists beside the WAL and the
+           journal syncs to disk.
+
+        Idempotent: a second call reports the current state.  Returns a
+        machine-readable summary (the ``/admin/drain`` response body).
+        """
+        with self._lock:
+            if self._lifecycle != "serving":
+                return {"state": self._lifecycle, "already_draining": True}
+            self._lifecycle = "draining"
+        started = self.busy_depth()
+        rec = trace.active()
+        if rec is not None:
+            rec.event(
+                None, "drain.begin", "engine.lifecycle",
+                node=self.trace_node, busy=started,
+            )
+        jr = self._journal()
+        # 1. Detach unstarted work.
+        detached: list[Job] = []
+        while True:
+            try:
+                j = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not j.done.is_set():
+                detached.append(j)
+        for rf in self._resident_flights():
+            detached.extend(rf.detach_pending())
+        handoffs = journaled = 0
+        for j in detached:
+            shipped = False
+            if handoff is not None and not j.shadow and j.roots is None:
+                try:
+                    shipped = bool(handoff(j))
+                except Exception:  # noqa: BLE001 — a dead peer must not sink the drain
+                    _LOG.exception(
+                        "[engine] drain handoff failed for %s", j.uuid
+                    )
+            if shipped:
+                handoffs += 1
+                if jr is not None and not j.shadow:
+                    # The peer owns it now (and journals its own accept);
+                    # discharge ours so the restart does not double-run it.
+                    jr.record_resolved(j.uuid, {"handoff": True})
+                j.error = "draining: handed off to peer"
+            else:
+                journaled += 1
+                # WAL entry stays accepted-only -> replayed on restart
+                # (root parts have no entry; their origin re-executes).
+                j.error = "draining: journaled for restart"
+            j.done.set()
+        # 2. Wait out the in-flight work.  Spin-count pacing (not clock
+        # math) so an injected virtual clock cannot hang the drain.
+        spins = max(1, int(timeout / 0.02))
+        while spins > 0 and self.busy_depth() > 0:
+            spins -= 1
+            self._drain_wait.wait(0.02)
+        leftover = self.busy_depth()
+        # 3. Persist the warm state beside the WAL.
+        if jr is not None:
+            if self.frontdoor is not None:
+                jr.save_frontdoor(self.frontdoor.cache.export_hot())
+            jr.sync_now()
+        finished = max(0, started - len(detached) - leftover)
+        with self._lock:
+            self._lifecycle = "drained"
+            self.drain_handoffs += handoffs
+            self.drain_journaled += journaled
+            self.drain_finished += finished
+        if rec is not None:
+            rec.event(
+                None, "drain.done", "engine.lifecycle",
+                node=self.trace_node, handoffs=handoffs,
+                journaled=journaled, finished=finished, leftover=leftover,
+            )
+        return {
+            "state": "drained",
+            "handoffs": handoffs,
+            "journaled": journaled,
+            "finished": finished,
+            "leftover": leftover,
+        }
 
     # -- client API ----------------------------------------------------------
     def submit(
@@ -500,6 +744,19 @@ class SolverEngine:
         geom = geom or geometry_for_size(g.shape[0])
         if g.shape != (geom.n, geom.n):
             raise ValueError(f"grid shape {g.shape} does not match geometry {geom}")
+        if job_uuid is not None and not shadow:
+            # Idempotent resubmit: a duplicate of an in-flight/resolved
+            # uuid returns the existing job (its verdict, once done)
+            # instead of double-solving — no stats/SLO stream counts the
+            # request twice.  Checked BEFORE the drain gate so clients
+            # polling by resubmit still get answers while draining.
+            prev = self._dup_job(job_uuid)
+            if prev is not None:
+                return prev
+        if not shadow:
+            with self._lock:
+                if self._lifecycle != "serving":
+                    raise EngineDraining(self._lifecycle)
         job = Job(
             uuid=job_uuid or str(uuid_mod.uuid4()), grid=g, geom=geom,
             config=config, shadow=shadow,
@@ -513,43 +770,73 @@ class SolverEngine:
             job.trace_t0 = rec.now()
         if deadline_s is not None:
             job.deadline = job.submitted_at + deadline_s
-        fd_token = None
-        fd_routed = False
-        if (
-            frontdoor
-            and self.frontdoor is not None
-            and config is None
-            and not self.config.count_all
-            and not shadow  # the race's fallback must not re-enter the door
-        ):
-            # The front door owns cache/propagation/native verdicts;
-            # owned=False means "hard tail" — fall through to the device
-            # paths below, then COMMIT the routing decision (counters,
-            # cache-fill registration) only once placement succeeded, so
-            # an EngineSaturated 429 never inflates the device-route
-            # counters or parks a dead cache-fill entry.  ``saturation``
-            # rides along for the brownout gate (serving/brownout.py):
-            # only reject-mode submits — the serving boundary — may be
-            # shed with a BrownoutShed raise; quiet callers degrade.
-            owned, fd_token = self.frontdoor.route(job, saturation=saturation)
-            if owned:
-                return job
-            fd_routed = True
-        if self._megastep_eligible(job, latency):
-            # Commit the front-door routing decision BEFORE the flight:
-            # the megastep resolves synchronously on this thread, and the
-            # cache-fill hook (frontdoor.commit_device installs
-            # job.on_resolve) must be registered when _finish_job fires.
+        # The WAL promise (serving/journal.py): `accepted` is on record
+        # BEFORE any routing — and therefore before the client's 201.  A
+        # rejected placement (saturation 429, brownout/drain shed) never
+        # answered 201, so the except arm discharges the entry; a crash
+        # mid-routing leaves it accepted-only, and the replay of a job
+        # whose client saw an error is idempotent by design.
+        jr = None if shadow else self._journal()
+        if jr is not None:
+            jr.record_accepted(
+                job.uuid, grid=g,
+                config=dataclasses.asdict(config) if config is not None else None,
+                deadline_s=deadline_s,
+                geom=f"{geom.n}x{geom.n}",
+            )
+        if not shadow:
+            with self._lock:
+                self._jobs_by_uuid[job.uuid] = job
+                while len(self._jobs_by_uuid) > 8192:  # stale-entry bound
+                    self._jobs_by_uuid.pop(next(iter(self._jobs_by_uuid)))
+        try:
+            fd_token = None
+            fd_routed = False
+            if (
+                frontdoor
+                and self.frontdoor is not None
+                and config is None
+                and not self.config.count_all
+                and not shadow  # the race's fallback must not re-enter the door
+            ):
+                # The front door owns cache/propagation/native verdicts;
+                # owned=False means "hard tail" — fall through to the device
+                # paths below, then COMMIT the routing decision (counters,
+                # cache-fill registration) only once placement succeeded, so
+                # an EngineSaturated 429 never inflates the device-route
+                # counters or parks a dead cache-fill entry.  ``saturation``
+                # rides along for the brownout gate (serving/brownout.py):
+                # only reject-mode submits — the serving boundary — may be
+                # shed with a BrownoutShed raise; quiet callers degrade.
+                owned, fd_token = self.frontdoor.route(job, saturation=saturation)
+                if owned:
+                    return job
+                fd_routed = True
+            if self._megastep_eligible(job, latency):
+                # Commit the front-door routing decision BEFORE the flight:
+                # the megastep resolves synchronously on this thread, and the
+                # cache-fill hook (frontdoor.commit_device installs
+                # job.on_resolve) must be registered when _finish_job fires.
+                if fd_routed:
+                    self.frontdoor.commit_device(job, fd_token)
+                    fd_routed = False
+                if self._route_megastep(job):
+                    return job
+            if not self._route_resident(job, saturation):
+                self._enqueue(job)
             if fd_routed:
                 self.frontdoor.commit_device(job, fd_token)
-                fd_routed = False
-            if self._route_megastep(job):
-                return job
-        if not self._route_resident(job, saturation):
-            self._enqueue(job)
-        if fd_routed:
-            self.frontdoor.commit_device(job, fd_token)
-        return job
+            return job
+        except BaseException:
+            # Placement failed — the client gets an error, not a 201, so
+            # the uuid must not look in-flight (registry) or replayable
+            # (WAL): discharge both before re-raising.
+            if not shadow:
+                with self._lock:
+                    self._jobs_by_uuid.pop(job.uuid, None)
+            if jr is not None:
+                jr.record_resolved(job.uuid, {"cancelled": True, "rejected": True})
+            raise
 
     def _route_resident(self, job: Job, saturation: str) -> bool:
         """True if the job was admitted to a resident flight."""
@@ -719,6 +1006,13 @@ class SolverEngine:
             roots=r,
             config=config,
         )
+        with self._lock:
+            if self._lifecycle != "serving":
+                # Root parts are re-executed by their ORIGIN on failure —
+                # rejecting here routes them to a healthy peer; no local
+                # WAL entry is taken for them (the origin keeps the
+                # parent job journaled).
+                raise EngineDraining(self._lifecycle)
         job.submitted_at = self._clock()  # engine-clock stamp, as in submit()
         rec = trace.active()
         if rec is not None:
@@ -999,6 +1293,25 @@ class SolverEngine:
             # section obs/agg.py rolls up cluster-wide and /status scans
             # for browning-out members.
             out["brownout"] = bo.metrics()
+        jr = self._journal()
+        if jr is not None:
+            # Durability plane (serving/journal.py): WAL depth, degrade
+            # counters, compaction totals — the families promck validates.
+            out["journal"] = jr.metrics()
+        # The drain ladder + recovery counters, read lock-free like every
+        # other guarded counter here (readers tolerate staleness).
+        # `state` is numeric for the Prometheus plane (0=serving
+        # 1=draining 2=drained); /status carries the string.
+        out["lifecycle"] = {
+            "state": ("serving", "draining", "drained").index(
+                self._lifecycle
+            ),
+            "drain_handoffs": int(self.drain_handoffs),
+            "drain_journaled": int(self.drain_journaled),
+            "drain_finished": int(self.drain_finished),
+            "recovered_jobs": int(self.recovered_jobs),
+            "resubmit_registry": len(self._jobs_by_uuid),
+        }
         if self._occ_chunks > 0:
             # Lane-occupancy inside fused dispatches: counts[k] = lanes
             # observed live for [10k, 10(k+1))% of the rounds their chunk
@@ -1059,6 +1372,7 @@ class SolverEngine:
             for job in jobs:
                 if self._consume_cancel(job):
                     job.cancelled = True
+                    self._journal_resolved(job)  # cancel IS a real verdict
                     job.done.set()
                 else:
                     live.append(job)
@@ -1805,6 +2119,9 @@ class SolverEngine:
                 _LOG.exception(
                     "[engine] on_resolve hook failed for %s", job.uuid
                 )
+        # WAL discharge (serving/journal.py): buffered, so safe on the
+        # device loop; real verdicts only (errors stay replayable).
+        self._journal_resolved(job)
         job.done.set()
 
     # -- control requests (snapshot / shed) ----------------------------------
@@ -1992,6 +2309,7 @@ class SolverEngine:
                     _LOG.exception(
                         "[engine] on_resolve hook failed for %s", job.uuid
                     )
+            self._journal_resolved(job)  # WAL discharge, as in _finish_job
             job.done.set()
         self.batch_sizes.record(float(len(group)))
         with self._lock:  # shared with megastep-thread resolutions since round 19

@@ -81,6 +81,48 @@ class ResultCache:
             self._entries.move_to_end(digest)
             self.insertions += 1
 
+    def export_hot(self, limit: int = 1024) -> list:
+        """Drain-time snapshot (serving/journal.py sidecar): the hottest
+        ``limit`` entries as JSON-able dicts, hottest LAST so re-importing
+        in order restores the LRU recency ranking."""
+        with self._lock:
+            items = list(self._entries.items())[-max(1, int(limit)):]
+        return [
+            {
+                "digest": digest,
+                "verdict": e.verdict,
+                "solution": None if e.solution is None
+                else [[int(v) for v in row] for row in e.solution],
+                "nodes": int(e.nodes),
+                "raw_digest": e.raw_digest,
+                "route": e.route,
+            }
+            for digest, e in items
+        ]
+
+    def import_hot(self, entries: list) -> int:
+        """Restore a drain-time snapshot on boot (the cache-warm half of
+        journal recovery).  Malformed entries are skipped — a stale or
+        truncated snapshot degrades to a colder cache, never an error."""
+        n = 0
+        for d in entries:
+            if not isinstance(d, dict):
+                continue
+            try:
+                sol = d.get("solution")
+                entry = CacheEntry(
+                    verdict=str(d["verdict"]),
+                    solution=None if sol is None else np.asarray(sol, np.int8),
+                    nodes=int(d.get("nodes", 0)),
+                    raw_digest=str(d.get("raw_digest", "")),
+                    route=str(d.get("route", "restored")),
+                )
+            except (KeyError, TypeError, ValueError):
+                continue
+            self.store_entry(str(d["digest"]), entry)
+            n += 1
+        return n
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

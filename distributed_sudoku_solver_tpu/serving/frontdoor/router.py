@@ -398,6 +398,9 @@ class FrontDoor:
                 job.uuid, job.probe_score, job.probe_empties, route,
                 wall * 1000.0, job.solved, job.unsat, job.nodes,
             )
+        # Front-door-owned verdicts never cross _finish_job, so the WAL
+        # discharge (serving/journal.py) happens here.
+        eng._journal_resolved(job)
         job.done.set()
 
     def _native_verdict(self, job, cf, raw) -> None:
@@ -431,6 +434,9 @@ class FrontDoor:
                 wall * 1000.0, job.solved, job.unsat, job.nodes,
             )
         self._fill_cache(cf, raw, job)
+        # The race's primary job resolves here (its device fallback is a
+        # shadow _finish_job skips): discharge the WAL entry.
+        eng._journal_resolved(job)
 
     def _device_resolved(self, job) -> None:
         """Job.on_resolve hook: runs inside engine._finish_job (device

@@ -167,7 +167,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from distributed_sudoku_solver_tpu.obs import agg, slo, trace
 from distributed_sudoku_solver_tpu.serving.brownout import BrownoutShed
-from distributed_sudoku_solver_tpu.serving.engine import SolverEngine
+from distributed_sudoku_solver_tpu.serving.engine import EngineDraining, SolverEngine
 from distributed_sudoku_solver_tpu.serving.scheduler import EngineSaturated
 
 # Opt-in access log (--access-log): routed through logging, not the
@@ -193,6 +193,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._solve_batch()
         if url.path == "/profile":
             return self._profile()
+        if url.path == "/admin/drain":
+            return self._admin_drain()
         if url.path != "/solve":
             return self._send(404, {"error": "not found"})
         # ``POST /solve?latency=1`` — the interactive hard-tail route
@@ -222,6 +224,19 @@ class _Handler(BaseHTTPRequestHandler):
         if g.ndim != 2 or g.shape[0] != g.shape[1] or g.shape[0] < 1:
             return self._send(
                 400, {"error": f"sudoku must be a square grid, got shape {g.shape}"}
+            )
+        # Optional client-supplied idempotency key (ISSUE 20): a resubmit
+        # carrying the uuid a previous attempt returned (e.g. from a 504
+        # body) answers with the existing in-flight/resolved job instead
+        # of double-solving — and double-counting — it.
+        client_uuid = payload.get("uuid")
+        if client_uuid is not None and (
+            not isinstance(client_uuid, str)
+            or not client_uuid
+            or len(client_uuid) > 120
+        ):
+            return self._send(
+                400, {"error": "uuid must be a non-empty string (<=120 chars)"}
             )
         start = self._now()
         rec = trace.active()
@@ -284,12 +299,36 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             try:
                 job = (
-                    node.submit(grid, config=config, latency=True)
+                    node.submit(
+                        grid, config=config, latency=True,
+                        job_uuid=client_uuid,
+                    )
                     if latency
-                    else node.submit(grid, config=config)
+                    else node.submit(grid, config=config, job_uuid=client_uuid)
                 )
             except ValueError as e:
                 return self._send(400, {"error": str(e)})
+            except EngineDraining as e:
+                # Durable lifecycle (serving/engine.py drain ladder): the
+                # node is draining/drained, admission is closed.  503 with
+                # a machine-readable body — clients retry against another
+                # member after Retry-After; recorded shed (an honest
+                # refusal must not burn the error budget the drain is
+                # protecting).
+                self._record_solve(
+                    node, self._now() - start, 503, shed=True
+                )
+                return self._send(
+                    503,
+                    {
+                        "error": "draining",
+                        "state": e.state,
+                        "retry_after_s": round(e.retry_after_s, 3),
+                    },
+                    headers={
+                        "Retry-After": str(max(1, int(-(-e.retry_after_s // 1))))
+                    },
+                )
             except BrownoutShed as e:
                 # Brownout load shedding (serving/brownout.py): the stage
                 # ladder refused this request's tier at the front door.
@@ -622,6 +661,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(409, {"error": "a profile window is already open"})
         return self._send(200, {"logdir": logdir, "secs": secs})
 
+    def _admin_drain(self):
+        """``POST /admin/drain`` — walk the durable-lifecycle ladder
+        (ISSUE 20): close admission, let in-flight work finish (bounded
+        by ``timeout_s``, default 30), hand unstarted jobs to a healthy
+        peer or journal them, persist the front-door hot set, fsync the
+        WAL.  Runs synchronously on this handler thread (drain is bounded
+        by construction) and answers 200 with the engine's machine-
+        readable summary: ``{state, handoffs, journaled, finished,
+        leftover}``.  A second call while draining answers the current
+        state with ``already_draining`` — the ladder is idempotent."""
+        node = self.server.solver_node
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length)) if length else {}
+            timeout_s = float(payload.get("timeout_s", 30.0))
+            if not (0.0 <= timeout_s <= 600.0):
+                raise ValueError(f"timeout_s must be in [0, 600], got {timeout_s}")
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            return self._send(400, {"error": f"bad drain body: {e}"})
+        drain = getattr(node, "drain", None)
+        if drain is None:
+            return self._send(500, {"error": "node cannot drain"})
+        return self._send(200, drain(timeout=timeout_s))
+
     def do_GET(self):  # noqa: N802
         node = self.server.solver_node
         url = urlsplit(self.path)
@@ -937,7 +1000,7 @@ class StandaloneNode:
         self.engine = engine
         self.address = address
 
-    def submit(self, grid, config=None, latency=None):
+    def submit(self, grid, config=None, latency=None, job_uuid=None):
         import numpy as np
 
         g = np.asarray(grid, dtype=np.int32)
@@ -947,12 +1010,23 @@ class StandaloneNode:
         # resident admission queue raises EngineSaturated here and the
         # HTTP layer answers 429 + Retry-After.  Library callers using the
         # engine directly keep the quiet static-flight fallback.
+        # ``job_uuid`` is the client idempotency key (ISSUE 20): the
+        # engine's resubmit registry dedupes it.
         return self.engine.submit(
-            g, saturation="reject", config=config, latency=latency
+            g, saturation="reject", config=config, latency=latency,
+            job_uuid=job_uuid,
         )
 
     def cancel(self, job_uuid: str) -> None:
         self.engine.cancel(job_uuid)
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """``POST /admin/drain`` on a standalone node: no peers, so every
+        unstarted job journals for restart (handoff=None)."""
+        return self.engine.drain(timeout=timeout)
+
+    def recover(self) -> int:
+        return self.engine.recover()
 
     def stats_view(self) -> dict:
         s = self.engine.stats()

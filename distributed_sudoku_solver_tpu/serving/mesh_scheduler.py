@@ -142,6 +142,21 @@ class MeshResidentFlight(ResidentFlight):
         return mesh_detach(state, slot_mask, mesh=self.mesh)
 
     # -- any-thread surface --------------------------------------------------
+    def admission_pressure(self) -> tuple:
+        """Mesh-aware brownout signal (``serving/brownout.py`` queue/wait
+        closures): pending jobs that fit the mesh's FREE shard slots
+        attach on the next chunk, so they exert no sustained queue
+        pressure — subtract that headroom before normalizing.  A browning
+        node with ``mesh_devices`` headroom therefore gets WIDER (keeps
+        admitting into idle shards) before the controller sheds; a full
+        pool reads identically to the single-chip flight."""
+        with self._lock:
+            pending = len(self._pending)
+            free = sum(1 for s in self.slots if s is None)
+        frac = max(0, pending - free) / float(self.rcfg.queue_depth)
+        aw = self.admission_wait.snapshot()
+        return frac, (aw["p95"] if aw else 0.0)
+
     def metrics(self) -> dict:
         out = super().metrics()
         per = self.rcfg.job_slots

@@ -900,6 +900,18 @@ class ResidentFlight:
                     uuids=[j.uuid for j in survivors], error=label,
                 )
 
+    def detach_pending(self) -> list:
+        """Graceful drain (``SolverEngine.drain``): pop every queued job
+        that never attached to a slot and hand it back to the engine's
+        drain ladder (peer handoff or WAL replay).  Attached slots are
+        NOT touched — those jobs finish on the device.  Admission stays
+        open (``_closed`` untouched): the engine's drain gate already
+        rejects new submits, and a restart reuses this flight."""
+        with self._lock:
+            out = [j for j in self._pending if not j.done.is_set()]
+            self._pending.clear()
+        return out
+
     def fail(self, exc: BaseException) -> None:
         """Terminal failure (no recovery): fail every job this flight
         holds and close admission — future submits fall back to static

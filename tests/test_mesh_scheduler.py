@@ -301,3 +301,40 @@ def test_mesh_breaker_rebuild_after_collective_fault():
         finally:
             eng.stop(timeout=2)
     assert sum(inj.metrics()["injected"].values()) >= 1
+
+
+def _pressure_stub(cls, pending: int, free: int, slots: int, depth: int):
+    """A slots-shaped stand-in: ``admission_pressure`` reads only the
+    pending list, the slot array, ``rcfg.queue_depth``, and the wait
+    window — no devices needed to pin the arithmetic."""
+    import threading
+    from types import SimpleNamespace
+
+    fl = object.__new__(cls)
+    fl._lock = threading.Lock()
+    fl._pending = [object()] * pending
+    fl.slots = [None] * free + [object()] * (slots - free)
+    fl.rcfg = SimpleNamespace(queue_depth=depth)
+    fl.admission_wait = SimpleNamespace(snapshot=lambda: {})
+    return fl
+
+
+def test_mesh_admission_pressure_subtracts_free_slot_headroom():
+    """ISSUE 20 satellite: the brownout queue signal on a mesh flight.
+    Pending jobs that fit the mesh's FREE shard slots attach on the next
+    chunk — they are not sustained pressure — so a browning node with
+    ``mesh_devices`` headroom reads LOWER than the single-chip flight
+    and gets wider before the controller sheds.  With the pool full the
+    two flights read identically."""
+    # 4 pending, 3 free slots across the shards, queue_depth 8.
+    single = _pressure_stub(ResidentFlight, pending=4, free=3, slots=8, depth=8)
+    mesh = _pressure_stub(MeshResidentFlight, pending=4, free=3, slots=8, depth=8)
+    assert single.admission_pressure() == (0.5, 0.0)
+    assert mesh.admission_pressure() == (0.125, 0.0)  # (4 - 3) / 8
+    # Headroom covers everything pending: zero pressure, keep admitting.
+    roomy = _pressure_stub(MeshResidentFlight, pending=2, free=6, slots=8, depth=8)
+    assert roomy.admission_pressure() == (0.0, 0.0)
+    # Full pool: the mesh signal degenerates to the single-chip one.
+    full_s = _pressure_stub(ResidentFlight, pending=6, free=0, slots=8, depth=8)
+    full_m = _pressure_stub(MeshResidentFlight, pending=6, free=0, slots=8, depth=8)
+    assert full_m.admission_pressure() == full_s.admission_pressure() == (0.75, 0.0)

@@ -525,6 +525,33 @@ PROM_SAMPLE = {
         },
     },
     "megastep_unfit": 1,
+    # ISSUE-20 durability plane: WAL health (the `durable` boolean renders
+    # 1.0/0.0) and the drain-ladder lifecycle — `state` is already numeric
+    # at the source (0=serving 1=draining 2=drained) so the Prometheus
+    # plane needs no string mapping.
+    "journal": {
+        "durable": True,
+        "accepted": 42,
+        "resolved": 40,
+        "recovered": 3,
+        "unresolved": 2,
+        "pending": 1,
+        "append_failures": 0,
+        "fsync_failures": 0,
+        "dropped_non_durable": 0,
+        "compactions": 2,
+        "segments_removed": 2,
+        "segment_index": 3,
+        "fsync_interval_s": 0.05,
+    },
+    "lifecycle": {
+        "state": 0,
+        "drain_handoffs": 3,
+        "drain_journaled": 1,
+        "drain_finished": 2,
+        "recovered_jobs": 3,
+        "resubmit_registry": 5,
+    },
     "critpath": {
         "jobs": 12,
         "attribution_ms": {
